@@ -1,0 +1,71 @@
+"""flexflow_tpu — a TPU-native distributed DNN training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of FlexFlow
+(reference: tengjiang/FlexFlow): PyTorch-like / Keras-like model building,
+a Parallel Computation Graph (PCG) whose tensors carry per-dimension
+sharding degrees, automatic hybrid-parallelization search (substitutions +
+DP/MCMC over an execution simulator with a TPU machine model), and
+execution via a single pjit-compiled step function over a
+``jax.sharding.Mesh`` (GSPMD) instead of a task runtime.
+
+Layer map (cf. reference SURVEY.md §1):
+  L1 kernels        -> XLA HLO + Pallas (flexflow_tpu/ops/pallas_kernels)
+  L2 operators      -> flexflow_tpu/ops (pure JAX functions + Op metadata)
+  L3 core runtime   -> flexflow_tpu/model.FFModel (compile/fit/forward/...)
+  L4 mapper         -> mesh axis assignment (flexflow_tpu/machine)
+  L5 auto-parallel  -> flexflow_tpu/search (PCG, substitutions, simulator)
+  L6/L7 frontends   -> flexflow_tpu/keras, torch_frontend, onnx_frontend
+  L9 models         -> flexflow_tpu/models
+"""
+
+from flexflow_tpu.version import __version__
+from flexflow_tpu.ffconst import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    ParameterSyncType,
+    PoolType,
+)
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape, Tensor
+from flexflow_tpu.machine import MachineSpec, MachineView
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.optimizers import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.initializers import (
+    ConstantInitializer,
+    GlorotUniformInitializer,
+    NormInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
+
+__all__ = [
+    "__version__",
+    "ActiMode",
+    "AggrMode",
+    "CompMode",
+    "DataType",
+    "LossType",
+    "MetricsType",
+    "OperatorType",
+    "ParameterSyncType",
+    "PoolType",
+    "FFConfig",
+    "ParallelDim",
+    "ParallelTensorShape",
+    "Tensor",
+    "MachineSpec",
+    "MachineView",
+    "FFModel",
+    "AdamOptimizer",
+    "SGDOptimizer",
+    "ConstantInitializer",
+    "GlorotUniformInitializer",
+    "NormInitializer",
+    "UniformInitializer",
+    "ZeroInitializer",
+]

@@ -1,0 +1,159 @@
+"""FFConfig: run configuration + CLI flag surface.
+
+Keeps the reference's flag names (FFConfig::parse_args,
+reference src/runtime/model.cc:3555-3720 and README.md:45-77) so scripts
+carry over, but the knobs now steer a mesh/GSPMD execution instead of
+Legion. GPU-count flags become chip counts; Legion memory flags become
+per-chip HBM budgets for the memory-aware search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from flexflow_tpu.ffconst import CompMode, ParameterSyncType
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # training flags (-e/-b/--learning-rate/...)
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    iterations: int = 1
+    seed: int = 42
+
+    # machine shape (reference -ll:gpu / --nodes; here: chips per host, hosts)
+    workers_per_node: int = 0  # chips per host; 0 = auto (all visible)
+    num_nodes: int = 1  # hosts (DCN-connected)
+    memory_per_chip_mb: int = 16 * 1024  # analog of -ll:fsize
+    machine_model_version: int = 0
+    machine_model_file: Optional[str] = None
+
+    # auto-parallelization search flags
+    search_budget: int = 0
+    search_alpha: float = 0.05
+    only_data_parallel: bool = False
+    enable_sample_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = True
+    search_overlap_backward_update: bool = False
+    base_optimize_threshold: int = 10
+    substitution_json: Optional[str] = None
+    memory_search: bool = False
+    memory_threshold_mb: Optional[int] = None
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    export_strategy_computation_graph_file: Optional[str] = None
+    include_costs_dot_graph: bool = False
+    simulator_segment_size: int = 16777216
+    simulator_max_num_segments: int = 1
+
+    # execution
+    computation_mode: CompMode = CompMode.TRAINING
+    parameter_sync: ParameterSyncType = ParameterSyncType.NCCL
+    perform_fusion: bool = True
+    profiling: bool = False
+    allow_mixed_precision: bool = True  # bf16 matmuls, f32 accumulate/params
+
+    @property
+    def num_devices(self) -> int:
+        """Explicit device count, or 0 meaning auto (use all visible)."""
+        return self.workers_per_node * self.num_nodes
+
+    def parse_args(self, argv: Sequence[str]) -> List[str]:
+        """Consume known flags from ``argv``; return unrecognized ones.
+
+        Mirrors the reference's manual scan (model.cc:3555): flags it does
+        not know are left for the application.
+        """
+        rest: List[str] = []
+        it = iter(range(len(argv)))
+        i = 0
+        args = list(argv)
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            if i >= len(args):
+                raise ValueError(f"flag {args[i - 1]} expects a value")
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-e", "--epochs"):
+                self.epochs = int(take())
+            elif a in ("-b", "--batch-size"):
+                self.batch_size = int(take())
+            elif a == "--learning-rate":
+                self.learning_rate = float(take())
+            elif a == "--weight-decay":
+                self.weight_decay = float(take())
+            elif a in ("-i", "--iterations"):
+                self.iterations = int(take())
+            elif a == "--seed":
+                self.seed = int(take())
+            elif a in ("-ll:gpu", "-ll:tpu", "--workers-per-node"):
+                self.workers_per_node = int(take())
+            elif a in ("-ll:fsize", "--memory-per-chip"):
+                self.memory_per_chip_mb = int(take())
+            elif a in ("-ll:zsize", "-ll:cpu", "-ll:util"):
+                take()  # Legion host-side knobs: accepted, no TPU meaning
+            elif a == "--nodes":
+                self.num_nodes = int(take())
+            elif a == "--budget" or a == "--search-budget":
+                self.search_budget = int(take())
+            elif a == "--alpha" or a == "--search-alpha":
+                self.search_alpha = float(take())
+            elif a == "--only-data-parallel":
+                self.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                self.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                # reference quirk: this flag set enable_parameter_parallel
+                # (model.cc:3616-3618); we set both, intentionally.
+                self.enable_parameter_parallel = True
+                self.enable_attribute_parallel = True
+            elif a == "--enable-sample-parallel":
+                self.enable_sample_parallel = True
+            elif a == "--search-num-nodes":
+                self.num_nodes = int(take())
+            elif a == "--search-num-workers":
+                self.workers_per_node = int(take())
+            elif a == "--base-optimize-threshold":
+                self.base_optimize_threshold = int(take())
+            elif a == "--substitution-json":
+                self.substitution_json = take()
+            elif a == "--memory-search":
+                self.memory_search = True
+            elif a == "--memory-threshold":
+                self.memory_threshold_mb = int(take())
+            elif a == "--export-strategy" or a == "--export":
+                self.export_strategy_file = take()
+            elif a == "--import-strategy" or a == "--import":
+                self.import_strategy_file = take()
+            elif a == "--export-strategy-computation-graph":
+                self.export_strategy_computation_graph_file = take()
+            elif a == "--include-costs-dot-graph":
+                self.include_costs_dot_graph = True
+            elif a == "--machine-model-version":
+                self.machine_model_version = int(take())
+            elif a == "--machine-model-file":
+                self.machine_model_file = take()
+            elif a == "--simulator-segment-size":
+                self.simulator_segment_size = int(take())
+            elif a == "--simulator-max-num-segments":
+                self.simulator_max_num_segments = int(take())
+            elif a == "--overlap":
+                self.search_overlap_backward_update = True
+            elif a == "--disable-fusion":
+                self.perform_fusion = False
+            elif a == "--profiling":
+                self.profiling = True
+            else:
+                rest.append(a)
+            i += 1
+        return rest

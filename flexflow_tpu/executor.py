@@ -1,0 +1,226 @@
+"""Graph executor: lowers the materialized op graph to jitted step functions.
+
+This is the TPU replacement for the reference's execution loop
+(FFModel::forward/backward/update, src/runtime/model.cc:2415-2475, plus the
+Legion trace around each iteration): instead of launching per-op index
+tasks that a mapper routes to devices, the whole iteration — forward, loss,
+autodiff backward, metrics, optimizer update (with its gradient psum over
+the data axis) — is one XLA computation compiled by jax.jit against a
+``jax.sharding.Mesh``. The per-op sharding decisions from the strategy are
+applied as (a) NamedShardings on parameters and (b)
+``with_sharding_constraint`` on op outputs (the four parallel ops of the
+PCG become constraint boundaries — SURVEY §2.3 mapping).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.ffconst import CompMode, LossType, OperatorType
+from flexflow_tpu.losses import get_loss_fn
+from flexflow_tpu.metrics import Metrics
+from flexflow_tpu.ops.base import Op, OpContext
+
+
+class OpNode:
+    """One materialized operator + where its inputs come from.
+
+    ``input_refs``: list of ('op', producer_guid, out_idx) or
+    ('input', input_name) or ('label', 0).
+    """
+
+    def __init__(self, op: Op, input_refs: List[Tuple]):
+        self.op = op
+        self.input_refs = input_refs
+        # sharding decision: per-output PartitionSpec (set by the strategy)
+        self.output_specs: List[Optional[P]] = [None] * len(op.output_shapes)
+        self.param_specs: Dict[str, P] = {}
+
+    @property
+    def guid(self):
+        return self.op.guid
+
+
+class GraphExecutor:
+    def __init__(
+        self,
+        nodes: List[OpNode],
+        input_names: List[str],
+        final_guid: int,
+        mesh: Mesh,
+        loss_type: LossType,
+        metrics: Metrics,
+        optimizer,
+        compute_dtype=jnp.bfloat16,
+        data_axes: Tuple[str, ...] = ("data",),
+        final_is_softmax: bool = False,
+    ):
+        self.nodes = nodes
+        self.by_guid = {n.guid: n for n in nodes}
+        self.input_names = input_names
+        self.final_guid = final_guid
+        self.mesh = mesh
+        self.loss_type = loss_type
+        self.metrics = metrics
+        self.optimizer = optimizer
+        self.compute_dtype = compute_dtype
+        self.data_axes = data_axes
+        self.final_is_softmax = final_is_softmax
+        self._jit_train = None
+        self._jit_eval = None
+        self._jit_fwd = {}  # keyed by training flag
+
+    # ---- parameter / state initialization ---------------------------------
+    def init_params_and_state(self, rng) -> Tuple[Dict, Dict]:
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        state: Dict[str, Dict[str, jax.Array]] = {}
+
+        def _init(rng):
+            p = {}
+            for node in self.nodes:
+                rng, sub = jax.random.split(rng)
+                ps = node.op.init_params(sub)
+                if ps:
+                    p[node.op.name] = ps
+            return p
+
+        params = jax.jit(_init)(rng)
+        params = jax.device_put(params, self.param_shardings(params))
+        for node in self.nodes:
+            if hasattr(node.op, "init_state"):
+                state[node.op.name] = node.op.init_state()
+        return params, state
+
+    def param_shardings(self, params):
+        def spec_for(op_name, pname, arr):
+            node = next(n for n in self.nodes if n.op.name == op_name)
+            spec = node.param_specs.get(pname, P())
+            return NamedSharding(self.mesh, spec)
+
+        return {
+            op_name: {
+                pn: spec_for(op_name, pn, a) for pn, a in sub.items()
+            }
+            for op_name, sub in params.items()
+        }
+
+    # ---- forward graph traversal ------------------------------------------
+    def run_graph(self, params, state, inputs: Dict[str, jax.Array],
+                  ctx: OpContext):
+        """Evaluate ops in topo order; returns (values, new_state, aux_losses).
+
+        aux_losses collects regularizer terms ops emit during forward (e.g.
+        the MoE load-balance loss the reference computes inside Aggregate's
+        backward, src/ops/aggregate.cu) — they are added to the objective.
+        """
+        values: Dict[Tuple[int, int], jax.Array] = {}
+        new_state: Dict[str, Any] = {}
+        aux_losses: List[jax.Array] = []
+        for node in self.nodes:
+            op = node.op
+            args = []
+            for ref in node.input_refs:
+                if ref[0] == "op":
+                    args.append(values[(ref[1], ref[2])])
+                else:
+                    args.append(inputs[ref[1]])
+            op_params = params.get(op.name, {})
+            if hasattr(op, "init_state"):
+                outs = op.forward(op_params, args, ctx, state=state.get(op.name))
+                if getattr(op, "_new_state", None) is not None:
+                    new_state[op.name] = op._new_state
+                    op._new_state = None
+                elif op.name in state:
+                    new_state[op.name] = state[op.name]
+            else:
+                outs = op.forward(op_params, args, ctx)
+            if getattr(op, "_aux_loss", None) is not None:
+                aux_losses.append(op._aux_loss)
+                op._aux_loss = None
+            for i, o in enumerate(outs):
+                spec = node.output_specs[i]
+                if spec is not None:
+                    o = jax.lax.with_sharding_constraint(
+                        o, NamedSharding(self.mesh, spec)
+                    )
+                values[(op.guid, i)] = o
+        return values, new_state, aux_losses
+
+    # ---- jitted steps ------------------------------------------------------
+    def _loss_value(self, logits, labels):
+        fn = get_loss_fn(self.loss_type)
+        if self.final_is_softmax and self.loss_type in (
+            LossType.CATEGORICAL_CROSSENTROPY,
+            LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        ):
+            # final op already produced probabilities (reference pairs a
+            # Softmax op with CE loss — loss_functions.cc:41)
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-12, 1.0))
+            if self.loss_type == LossType.CATEGORICAL_CROSSENTROPY:
+                return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+            lab = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+            return -jnp.mean(jnp.take_along_axis(logp, lab[:, None], axis=-1))
+        return fn(logits, labels)
+
+    def make_train_step(self):
+        if self._jit_train is not None:
+            return self._jit_train
+
+        def train_step(params, opt_state, state, inputs, labels, rng):
+            def loss_fn(p):
+                ctx = OpContext(training=True, rng=rng,
+                                compute_dtype=self.compute_dtype)
+                values, new_state, aux = self.run_graph(p, state, inputs, ctx)
+                logits = values[(self.final_guid, 0)]
+                loss = self._loss_value(logits, labels)
+                for a in aux:
+                    loss = loss + a
+                return loss, (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            # gradient allreduce over data axes is inserted by GSPMD here
+            new_params, new_opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            metric_vals = self.metrics.compute(logits, labels)
+            return new_params, new_opt_state, new_state, loss, metric_vals
+
+        self._jit_train = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return self._jit_train
+
+    def make_eval_step(self):
+        if self._jit_eval is not None:
+            return self._jit_eval
+
+        def eval_step(params, state, inputs, labels):
+            ctx = OpContext(training=False, compute_dtype=self.compute_dtype)
+            values, _, _ = self.run_graph(params, state, inputs, ctx)
+            logits = values[(self.final_guid, 0)]
+            loss = self._loss_value(logits, labels)
+            return loss, logits, self.metrics.compute(logits, labels)
+
+        self._jit_eval = jax.jit(eval_step)
+        return self._jit_eval
+
+    def make_forward(self, training: bool = False):
+        if training in self._jit_fwd:
+            return self._jit_fwd[training]
+
+        def fwd(params, state, inputs, rng):
+            ctx = OpContext(training=training, rng=rng,
+                            compute_dtype=self.compute_dtype)
+            values, new_state, _ = self.run_graph(params, state, inputs, ctx)
+            return values[(self.final_guid, 0)], new_state
+
+        self._jit_fwd[training] = jax.jit(fwd)
+        return self._jit_fwd[training]
+
+    def batch_sharding(self):
+        return NamedSharding(self.mesh, P(tuple(self.data_axes)))

@@ -1,0 +1,174 @@
+"""Framework-wide enums.
+
+TPU-native analog of the reference's ``include/flexflow/ffconst.h`` enum
+surface (OperatorType ffconst.h:63-156, DataType, LossType :33-39,
+MetricsType :52-60, CompMode, ParameterSyncType :46, ActiMode, AggrMode,
+PoolType). Values are our own; names keep API parity so frontends and
+strategy files interoperate.
+"""
+
+import enum
+
+import jax.numpy as jnp
+
+
+class DataType(enum.Enum):
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.value)
+
+    @property
+    def size(self) -> int:
+        return self.jnp_dtype.itemsize
+
+    @classmethod
+    def from_jnp(cls, dtype) -> "DataType":
+        return cls(jnp.dtype(dtype).name)
+
+
+class ActiMode(enum.Enum):
+    AC_MODE_NONE = 0
+    AC_MODE_RELU = 1
+    AC_MODE_SIGMOID = 2
+    AC_MODE_TANH = 3
+    AC_MODE_GELU = 4
+
+
+class AggrMode(enum.Enum):
+    AGGR_MODE_NONE = 0
+    AGGR_MODE_SUM = 1
+    AGGR_MODE_AVG = 2
+
+
+class PoolType(enum.Enum):
+    POOL_MAX = 0
+    POOL_AVG = 1
+
+
+class LossType(enum.Enum):
+    CATEGORICAL_CROSSENTROPY = 10
+    SPARSE_CATEGORICAL_CROSSENTROPY = 11
+    MEAN_SQUARED_ERROR_AVG_REDUCE = 12
+    MEAN_SQUARED_ERROR_SUM_REDUCE = 13
+    IDENTITY = 14
+
+
+class MetricsType(enum.Enum):
+    ACCURACY = 1001
+    CATEGORICAL_CROSSENTROPY = 1002
+    SPARSE_CATEGORICAL_CROSSENTROPY = 1003
+    MEAN_SQUARED_ERROR = 1004
+    ROOT_MEAN_SQUARED_ERROR = 1005
+    MEAN_ABSOLUTE_ERROR = 1006
+
+
+class CompMode(enum.Enum):
+    TRAINING = 0
+    INFERENCE = 1
+
+
+class ParameterSyncType(enum.Enum):
+    """How gradients are synchronized across data-parallel replicas.
+
+    On TPU both map to a ``psum`` over the data mesh axes inside the jitted
+    step (the reference distinguishes a zero-copy parameter server from NCCL
+    allreduce — config.h:55-59); we keep the names for config parity.
+    """
+
+    NONE = 0
+    PS = 1
+    NCCL = 2
+
+
+class OperatorType(enum.Enum):
+    # sources
+    NOOP = enum.auto()
+    INPUT = enum.auto()
+    WEIGHT = enum.auto()
+    # dense / conv stack
+    CONV2D = enum.auto()
+    POOL2D = enum.auto()
+    BATCHNORM = enum.auto()
+    LINEAR = enum.auto()
+    EMBEDDING = enum.auto()
+    # attention / transformer
+    MULTIHEAD_ATTENTION = enum.auto()
+    LAYERNORM = enum.auto()
+    SOFTMAX = enum.auto()
+    # elementwise
+    EW_ADD = enum.auto()
+    EW_SUB = enum.auto()
+    EW_MUL = enum.auto()
+    EW_DIV = enum.auto()
+    EW_MAX = enum.auto()
+    EW_MIN = enum.auto()
+    RELU = enum.auto()
+    GELU = enum.auto()
+    SIGMOID = enum.auto()
+    TANH = enum.auto()
+    ELU = enum.auto()
+    EXP = enum.auto()
+    SIN = enum.auto()
+    COS = enum.auto()
+    POW = enum.auto()
+    RSQRT = enum.auto()
+    IDENTITY = enum.auto()
+    SCALAR_MULTIPLY = enum.auto()
+    SCALAR_ADD = enum.auto()
+    SCALAR_SUB = enum.auto()
+    SCALAR_TRUE_DIV = enum.auto()
+    # matmul / shape
+    BATCHMATMUL = enum.auto()
+    CONCAT = enum.auto()
+    SPLIT = enum.auto()
+    RESHAPE = enum.auto()
+    TRANSPOSE = enum.auto()
+    FLAT = enum.auto()
+    REVERSE = enum.auto()
+    CAST = enum.auto()
+    DROPOUT = enum.auto()
+    GATHER = enum.auto()
+    REDUCE_SUM = enum.auto()
+    MEAN = enum.auto()
+    TOPK = enum.auto()
+    ARG_TOPK = enum.auto()
+    # MoE quartet (+ gating sugar)
+    GROUP_BY = enum.auto()
+    AGGREGATE = enum.auto()
+    AGGREGATE_SPEC = enum.auto()
+    CACHE = enum.auto()
+    EXPERTS = enum.auto()
+    # fused compute
+    FUSED = enum.auto()
+    # parallel (resharding) ops — first-class PCG citizens (ffconst.h:149-156)
+    REPARTITION = enum.auto()
+    COMBINE = enum.auto()
+    REPLICATE = enum.auto()
+    REDUCTION = enum.auto()
+    PIPELINE = enum.auto()
+    FUSED_PARALLEL = enum.auto()
+    # loss/metrics pseudo-ops (appear in taskgraph simulation)
+    LOSS = enum.auto()
+    METRICS = enum.auto()
+    OPTIMIZER = enum.auto()
+    ALLREDUCE = enum.auto()
+
+
+PARALLEL_OP_TYPES = frozenset(
+    {
+        OperatorType.REPARTITION,
+        OperatorType.COMBINE,
+        OperatorType.REPLICATE,
+        OperatorType.REDUCTION,
+        OperatorType.PIPELINE,
+        OperatorType.FUSED_PARALLEL,
+    }
+)

@@ -1,0 +1,68 @@
+"""Parameter initializers.
+
+Analog of include/flexflow/initializer.h:26-110 (Glorot/Zero/Uniform/
+Normal/Constant); the reference runs them as Legion index tasks with
+curand (initializer_kernel.cu) — here each is a pure function of a PRNG
+key, executed sharded by GSPMD at init time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, rng: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+        raise NotImplementedError
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, rng, shape, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_val: float = -0.05, max_val: float = 0.05):
+        self.seed, self.min_val, self.max_val = seed, min_val, max_val
+
+    def __call__(self, rng, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng, shape, dtype, self.min_val, self.max_val)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed, self.mean, self.stddev = seed, mean, stddev
+
+    def __call__(self, rng, shape, dtype=jnp.float32):
+        return self.mean + self.stddev * jax.random.normal(rng, shape, dtype)
+
+
+class GlorotUniformInitializer(Initializer):
+    """Glorot/Xavier uniform over (fan_in, fan_out) like the reference's
+    GlorotUniform (initializer_kernel.cu glorot path)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, rng, shape, dtype=jnp.float32):
+        if len(shape) >= 2:
+            fan_out = shape[-1]
+            fan_in = int(np.prod(shape[:-1]))
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+DefaultWeightInitializer = GlorotUniformInitializer
+DefaultBiasInitializer = ZeroInitializer

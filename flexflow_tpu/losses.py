@@ -1,0 +1,58 @@
+"""Loss functions.
+
+Analog of src/loss_functions/ (loss_functions.cc:41,71): categorical CE,
+sparse categorical CE, MSE (avg/sum reduce), identity. The reference
+launches LOSS_BWD_TASK_ID to seed gradients and scales by 1/num_replicas
+when the final op is replicated; here the loss is part of the jitted
+scalar objective and jax.grad seeds it — replica scaling is what
+jnp.mean over the global (sharded) batch already does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType
+
+
+def categorical_crossentropy(logits, labels):
+    """labels one-hot [B, C]; logits pre-softmax (the reference pairs this
+    with a Softmax final op — we accept probabilities too)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(logits, labels):
+    labels = labels.reshape(labels.shape[0], -1)[..., 0] if labels.ndim > 1 else labels
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1))
+
+
+def mse_avg(preds, labels):
+    return jnp.mean((preds.astype(jnp.float32) - labels.astype(jnp.float32)) ** 2)
+
+
+def mse_sum(preds, labels):
+    per_sample = jnp.sum(
+        (preds.astype(jnp.float32) - labels.astype(jnp.float32)) ** 2,
+        axis=tuple(range(1, preds.ndim)),
+    )
+    return jnp.mean(per_sample)
+
+
+def identity(preds, labels):
+    return jnp.mean(preds.astype(jnp.float32))
+
+
+LOSS_FNS = {
+    LossType.CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
+    LossType.SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
+    LossType.MEAN_SQUARED_ERROR_AVG_REDUCE: mse_avg,
+    LossType.MEAN_SQUARED_ERROR_SUM_REDUCE: mse_sum,
+    LossType.IDENTITY: identity,
+}
+
+
+def get_loss_fn(loss_type: LossType):
+    return LOSS_FNS[loss_type]

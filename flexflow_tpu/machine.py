@@ -1,0 +1,180 @@
+"""Machine description: TPU chips, ICI/DCN topology, mesh construction.
+
+Re-design of the reference's ``MachineView``/``MachineResource``
+(include/flexflow/machine_view.h:14,51) and the machine models used by the
+simulator (include/flexflow/simulator.h:212-515). On TPU the device grid is
+a named ``jax.sharding.Mesh``; a MachineView names the sub-grid an op runs
+on via (start, dims, strides) for search parity, and the machine spec
+carries the analytic parameters (FLOP/s, HBM BW, ICI/DCN link BW) the cost
+model needs (analog of machine_config_example:1-40).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineView:
+    """Device sub-grid assignment of one op (machine_view.h:14).
+
+    ``dim[i]``/``stride[i]`` enumerate device ids
+    ``start_device_id + sum_i k_i * stride_i`` for ``k_i < dim[i]`` — same
+    encoding as the reference so strategy files round-trip.
+    """
+
+    start_device_id: int
+    dim: Tuple[int, ...]
+    stride: Tuple[int, ...]
+
+    @property
+    def ndims(self) -> int:
+        return len(self.dim)
+
+    def num_parts(self) -> int:
+        return math.prod(self.dim) if self.dim else 1
+
+    def device_ids(self) -> Tuple[int, ...]:
+        ids = [self.start_device_id]
+        for d, s in zip(self.dim, self.stride):
+            ids = [i + k * s for i in ids for k in range(d)]
+        return tuple(sorted(ids))
+
+    def hash(self) -> int:
+        h = hash((self.start_device_id, self.dim, self.stride))
+        return h & 0x7FFFFFFFFFFFFFFF
+
+    @classmethod
+    def single_device(cls, device_id: int = 0) -> "MachineView":
+        return cls(device_id, (1,), (1,))
+
+    @classmethod
+    def all_devices(cls, num_devices: int) -> "MachineView":
+        return cls(0, (num_devices,), (1,))
+
+
+# Analytic chip specs for the TPU generations we model. Numbers are public
+# datasheet figures (bf16 peak FLOP/s, HBM bytes/s, HBM capacity, per-link
+# ICI bytes/s each direction, links per chip).
+CHIP_SPECS: Dict[str, Dict[str, float]] = {
+    "tpu-v4": dict(flops=275e12, hbm_bw=1.23e12, hbm_cap=32e9, ici_bw=45e9, ici_links=6),
+    "tpu-v5e": dict(flops=197e12, hbm_bw=0.82e12, hbm_cap=16e9, ici_bw=45e9, ici_links=4),
+    "tpu-v5p": dict(flops=459e12, hbm_bw=2.77e12, hbm_cap=95e9, ici_bw=90e9, ici_links=6),
+    "tpu-v6e": dict(flops=918e12, hbm_bw=1.64e12, hbm_cap=32e9, ici_bw=90e9, ici_links=4),
+    "cpu-sim": dict(flops=1e12, hbm_bw=100e9, hbm_cap=16e9, ici_bw=10e9, ici_links=4),
+}
+
+
+@dataclasses.dataclass
+class MachineSpec:
+    """One slice (ICI domain) of ``num_nodes`` DCN-connected slices.
+
+    Replaces SimpleMachineModel/EnhancedMachineModel/NetworkedMachineModel
+    (simulator.h:212,229,279,515): TPU topology is a torus, so instead of an
+    adjacency matrix we carry per-axis torus extents and link bandwidths.
+    """
+
+    chip: str = "tpu-v5e"
+    chips_per_slice: int = 1
+    num_slices: int = 1
+    torus: Optional[Tuple[int, ...]] = None  # e.g. (4, 4) for v5e-16
+    dcn_bw: float = 25e9  # bytes/s per slice pair
+    ici_latency: float = 1e-6
+    dcn_latency: float = 10e-6
+
+    def __post_init__(self):
+        if self.torus is None:
+            n = self.chips_per_slice
+            side = int(math.isqrt(n))
+            if side * side == n and n > 1:
+                self.torus = (side, side)
+            else:
+                self.torus = (n,)
+        spec = CHIP_SPECS[self.chip]
+        self.flops = spec["flops"]
+        self.hbm_bw = spec["hbm_bw"]
+        self.hbm_cap = spec["hbm_cap"]
+        self.ici_bw = spec["ici_bw"]
+
+    @property
+    def num_devices(self) -> int:
+        return self.chips_per_slice * self.num_slices
+
+    def ici_allreduce_time(self, bytes_: int, num_chips: int) -> float:
+        """Bidirectional-ring allreduce cost over ICI: 2(n-1)/n * B / bw."""
+        if num_chips <= 1:
+            return 0.0
+        eff_bw = self.ici_bw * 2  # bidirectional links
+        return self.ici_latency * (num_chips - 1) + (
+            2 * (num_chips - 1) / num_chips
+        ) * bytes_ / eff_bw
+
+    def ici_allgather_time(self, bytes_out: int, num_chips: int) -> float:
+        if num_chips <= 1:
+            return 0.0
+        eff_bw = self.ici_bw * 2
+        return self.ici_latency * (num_chips - 1) + (
+            (num_chips - 1) / num_chips
+        ) * bytes_out / eff_bw
+
+    def ici_alltoall_time(self, bytes_: int, num_chips: int) -> float:
+        if num_chips <= 1:
+            return 0.0
+        return self.ici_latency + bytes_ * (num_chips - 1) / num_chips / (
+            self.ici_bw * 2
+        )
+
+    def dcn_allreduce_time(self, bytes_: int) -> float:
+        if self.num_slices <= 1:
+            return 0.0
+        n = self.num_slices
+        return self.dcn_latency * (n - 1) + (2 * (n - 1) / n) * bytes_ / self.dcn_bw
+
+    def matmul_time(self, flops: int, dtype_size: int = 2) -> float:
+        # MXU peak assumed for bf16; f32 halves throughput
+        peak = self.flops if dtype_size <= 2 else self.flops / 2
+        return flops / peak
+
+    def memory_time(self, bytes_: int) -> float:
+        return bytes_ / self.hbm_bw
+
+
+def detect_machine_spec(num_devices: Optional[int] = None) -> MachineSpec:
+    """Build a MachineSpec from the live JAX backend (used at compile time)."""
+    import jax
+
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    kind = devs[0].device_kind.lower() if devs else "cpu"
+    if "v5 lite" in kind or "v5e" in kind:
+        chip = "tpu-v5e"
+    elif "v5p" in kind or "v5" in kind:
+        chip = "tpu-v5p"
+    elif "v4" in kind:
+        chip = "tpu-v4"
+    elif "v6" in kind:
+        chip = "tpu-v6e"
+    else:
+        chip = "cpu-sim"
+    return MachineSpec(chip=chip, chips_per_slice=n)
+
+
+def make_mesh(num_devices: int, axes: Dict[str, int]):
+    """Create a named ``jax.sharding.Mesh`` over the first ``num_devices``.
+
+    ``axes`` maps axis name -> extent; product must equal num_devices.
+    Canonical axis names: 'data' (sample dim), 'model' (parameter/attribute
+    dims), 'seq' (sequence/context parallelism), 'expert' (MoE).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    sizes = tuple(axes.values())
+    if math.prod(sizes) != num_devices:
+        raise ValueError(f"mesh axes {axes} != {num_devices} devices")
+    devs = np.array(jax.devices()[:num_devices]).reshape(sizes)
+    return Mesh(devs, tuple(axes.keys()))

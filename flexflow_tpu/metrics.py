@@ -1,0 +1,88 @@
+"""Metrics.
+
+Analog of src/metrics_functions/ (metrics_functions.cc:68,85): accuracy,
+categorical/sparse CE, MSE, RMSE, MAE. The reference accumulates
+PerfMetrics on-device and reduces through a Legion future chain
+(UPDATE_METRICS_TASK_ID); here metrics are computed inside the jitted step
+and accumulated as a PerfMetrics pytree — the cross-device reduction is
+implicit in computing on the global (sharded) batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Mirrors the reference's PerfMetrics accumulator fields."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: Dict[str, jax.Array], batch: int):
+        self.train_all += batch
+        for k, v in other.items():
+            if k == "accuracy":
+                self.train_correct += int(v)
+            else:
+                setattr(self, k, getattr(self, k) + float(v))
+
+    def report(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        n = max(self.train_all, 1)
+        if self.train_correct:
+            out["accuracy"] = self.train_correct / n
+        for f in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            v = getattr(self, f)
+            if v:
+                out[f] = v / n
+        return out
+
+
+class Metrics:
+    def __init__(self, loss_type: LossType, metrics: List[MetricsType]):
+        self.loss_type = loss_type
+        self.metrics = list(metrics)
+
+    def compute(self, preds: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
+        """Per-batch metric sums (not averaged), jit-traceable."""
+        out: Dict[str, jax.Array] = {}
+        b = preds.shape[0]
+        for m in self.metrics:
+            if m == MetricsType.ACCURACY:
+                if self.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                    lab = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+                    correct = jnp.argmax(preds, axis=-1) == lab
+                elif preds.ndim >= 2 and preds.shape[-1] > 1:
+                    correct = jnp.argmax(preds, axis=-1) == jnp.argmax(labels, axis=-1)
+                else:
+                    correct = (preds > 0.5).astype(jnp.int32).reshape(b, -1)[:, 0] == labels.reshape(b, -1)[:, 0]
+                out["accuracy"] = jnp.sum(correct.astype(jnp.int32))
+            elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
+                logp = jnp.log(jnp.clip(preds.astype(jnp.float32), 1e-12, 1.0))
+                out["cce_loss"] = -jnp.sum(labels * logp)
+            elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                lab = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+                logp = jnp.log(jnp.clip(preds.astype(jnp.float32), 1e-12, 1.0))
+                out["sparse_cce_loss"] = -jnp.sum(
+                    jnp.take_along_axis(logp, lab[:, None], axis=-1)
+                )
+            elif m == MetricsType.MEAN_SQUARED_ERROR:
+                out["mse_loss"] = jnp.sum(jnp.mean((preds - labels) ** 2, axis=-1))
+            elif m == MetricsType.ROOT_MEAN_SQUARED_ERROR:
+                out["rmse_loss"] = jnp.sum(jnp.sqrt(jnp.mean((preds - labels) ** 2, axis=-1)))
+            elif m == MetricsType.MEAN_ABSOLUTE_ERROR:
+                out["mae_loss"] = jnp.sum(jnp.mean(jnp.abs(preds - labels), axis=-1))
+        return out

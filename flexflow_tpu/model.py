@@ -1,0 +1,547 @@
+"""FFModel: the user-facing model builder + training runtime.
+
+TPU re-design of the reference FFModel (include/flexflow/model.h:326,
+src/runtime/model.cc): the same deferred layer-building API (dense, conv2d,
+multihead_attention, ..., model.h:380-520), a ``compile()`` that
+materializes operators from layers (create_operators_from_layers,
+model.cc:2784), picks a parallelization strategy, and builds the
+executable — here a single jitted train-step over a device mesh rather
+than Legion task launches. ``fit/eval`` mirror the Python frontend's loop
+(flexflow_cffi.py:2073-2086) and print the same
+``ELAPSED TIME / THROUGHPUT`` lines as the reference examples
+(examples/cpp/Transformer/transformer.cc:209-211).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.executor import GraphExecutor, OpNode
+from flexflow_tpu.ffconst import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    PoolType,
+)
+from flexflow_tpu.layer import Layer
+from flexflow_tpu.machine import MachineSpec, detect_machine_spec, make_mesh
+from flexflow_tpu.metrics import Metrics, PerfMetrics
+from flexflow_tpu.ops import OpRegistry
+from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
+from flexflow_tpu.tensor import Tensor
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.layers: List[Layer] = []
+        self.input_tensors: List[Tensor] = []
+        self.label_tensor: Optional[Tensor] = None
+        self.optimizer: Optional[Optimizer] = None
+        self.executor: Optional[GraphExecutor] = None
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.opt_state: Any = None
+        self.state: Dict[str, Any] = {}
+        self.machine_spec: Optional[MachineSpec] = None
+        self.mesh = None
+        self.strategy = None
+        self._rng = jax.random.PRNGKey(self.config.seed)
+        self._iter = 0
+        self._metrics_acc = PerfMetrics()
+        # parity loop state (forward/backward/update protocol)
+        self._current_batch = None
+        self._pending = None
+
+    # ======================= tensor/layer construction =====================
+    def create_tensor(self, dims: Sequence[int], dtype: DataType = DataType.FLOAT,
+                      create_grad: bool = True, name: Optional[str] = None) -> Tensor:
+        layer = Layer(OperatorType.INPUT, name or f"input_{len(self.input_tensors)}",
+                      [], data_type=dtype)
+        t = Tensor(dims, dtype, owner_layer=layer, name=layer.name)
+        layer.outputs = [t]
+        self.layers.append(layer)
+        self.input_tensors.append(t)
+        return t
+
+    def _add_layer(self, op_type: OperatorType, inputs: List[Tensor],
+                   props: Dict[str, Any], name: Optional[str] = None,
+                   dtype: Optional[DataType] = None) -> Layer:
+        layer = Layer(op_type, name, inputs,
+                      data_type=dtype or (inputs[0].dtype if inputs else DataType.FLOAT))
+        # parameters are keyed by layer name — names must be unique
+        if not hasattr(self, "_used_names"):
+            self._used_names = set()
+        if layer.name in self._used_names:
+            base = layer.name
+            layer.name = f"{base}_{layer.guid}"
+        self._used_names.add(layer.name)
+        layer.properties.update(props)
+        self.layers.append(layer)
+        return layer
+
+    def _finish(self, layer: Layer) -> Tensor:
+        op = OpRegistry.create(layer, [t.shape for t in layer.inputs])
+        outs = [
+            Tensor(s, layer.data_type, owner_layer=layer, owner_idx=i,
+                   name=f"{layer.name}_out{i}")
+            for i, s in enumerate(op.output_shapes)
+        ]
+        layer.outputs = outs
+        layer._op_proto = op  # cached; compile re-creates fresh ops
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    # ---- dense / conv stack (model.h:380-520 API parity) -------------------
+    def dense(self, input: Tensor, out_dim: int,
+              activation: ActiMode = ActiMode.AC_MODE_NONE, use_bias: bool = True,
+              datatype: Optional[DataType] = None, kernel_initializer=None,
+              bias_initializer=None, name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.LINEAR, [input], dict(
+            out_dim=out_dim, activation=activation, use_bias=use_bias,
+            kernel_initializer=kernel_initializer, bias_initializer=bias_initializer,
+        ), name, datatype)
+        return self._finish(layer)
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               activation: ActiMode = ActiMode.AC_MODE_NONE, groups: int = 1,
+               use_bias: bool = True, kernel_initializer=None,
+               bias_initializer=None, name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.CONV2D, [input], dict(
+            out_channels=out_channels, kernel_h=kernel_h, kernel_w=kernel_w,
+            stride_h=stride_h, stride_w=stride_w, padding_h=padding_h,
+            padding_w=padding_w, activation=activation, groups=groups,
+            use_bias=use_bias, kernel_initializer=kernel_initializer,
+            bias_initializer=bias_initializer), name)
+        return self._finish(layer)
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int, stride_h: int,
+               stride_w: int, padding_h: int, padding_w: int,
+               pool_type: PoolType = PoolType.POOL_MAX,
+               activation: ActiMode = ActiMode.AC_MODE_NONE,
+               name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.POOL2D, [input], dict(
+            kernel_h=kernel_h, kernel_w=kernel_w, stride_h=stride_h,
+            stride_w=stride_w, padding_h=padding_h, padding_w=padding_w,
+            pool_type=pool_type, activation=activation), name)
+        return self._finish(layer)
+
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.BATCHNORM, [input],
+                                dict(relu=relu), name)
+        return self._finish(layer)
+
+    def layer_norm(self, input: Tensor, axes: Sequence[int] = (-1,),
+                   elementwise_affine: bool = True, eps: float = 1e-5,
+                   name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.LAYERNORM, [input], dict(
+            axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps), name)
+        return self._finish(layer)
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+                  kernel_initializer=None, name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.EMBEDDING, [input], dict(
+            num_entries=num_entries, out_dim=out_dim, aggr=aggr,
+            kernel_initializer=kernel_initializer), name, DataType.FLOAT)
+        return self._finish(layer)
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0, bias: bool = True,
+                            add_bias_kv: bool = False, add_zero_attn: bool = False,
+                            causal: bool = False, kernel_initializer=None,
+                            name: Optional[str] = None) -> Tensor:
+        layer = self._add_layer(OperatorType.MULTIHEAD_ATTENTION,
+                                [query, key, value], dict(
+            embed_dim=embed_dim, num_heads=num_heads, kdim=kdim or embed_dim,
+            vdim=vdim or embed_dim, dropout=dropout, bias=bias, causal=causal,
+            kernel_initializer=kernel_initializer), name)
+        return self._finish(layer)
+
+    # ---- elementwise -------------------------------------------------------
+    def _unary(self, op_type, x, name=None, scalar=None, inplace=False):
+        layer = self._add_layer(op_type, [x], dict(scalar=scalar, inplace=inplace), name)
+        return self._finish(layer)
+
+    def _binary(self, op_type, a, b, name=None):
+        layer = self._add_layer(op_type, [a, b], {}, name)
+        return self._finish(layer)
+
+    def exp(self, x, name=None): return self._unary(OperatorType.EXP, x, name)
+    def sin(self, x, name=None): return self._unary(OperatorType.SIN, x, name)
+    def cos(self, x, name=None): return self._unary(OperatorType.COS, x, name)
+    def relu(self, x, inplace=True, name=None): return self._unary(OperatorType.RELU, x, name, inplace=inplace)
+    def gelu(self, x, name=None): return self._unary(OperatorType.GELU, x, name)
+    def sigmoid(self, x, name=None): return self._unary(OperatorType.SIGMOID, x, name)
+    def tanh(self, x, name=None): return self._unary(OperatorType.TANH, x, name)
+    def elu(self, x, inplace=True, name=None): return self._unary(OperatorType.ELU, x, name, inplace=inplace)
+    def rsqrt(self, x, name=None): return self._unary(OperatorType.RSQRT, x, name)
+    def identity(self, x, name=None): return self._unary(OperatorType.IDENTITY, x, name)
+    def pow(self, x, exponent, name=None): return self._unary(OperatorType.POW, x, name, scalar=exponent)
+    def scalar_multiply(self, x, scalar, inplace=True, name=None):
+        return self._unary(OperatorType.SCALAR_MULTIPLY, x, name, scalar=scalar, inplace=inplace)
+    def scalar_add(self, x, scalar, inplace=True, name=None):
+        return self._unary(OperatorType.SCALAR_ADD, x, name, scalar=scalar, inplace=inplace)
+    def scalar_sub(self, x, scalar, inplace=True, name=None):
+        return self._unary(OperatorType.SCALAR_SUB, x, name, scalar=scalar, inplace=inplace)
+    def scalar_true_divide(self, x, scalar, inplace=True, name=None):
+        return self._unary(OperatorType.SCALAR_TRUE_DIV, x, name, scalar=scalar, inplace=inplace)
+
+    def add(self, a, b, name=None): return self._binary(OperatorType.EW_ADD, a, b, name)
+    def subtract(self, a, b, name=None): return self._binary(OperatorType.EW_SUB, a, b, name)
+    def multiply(self, a, b, name=None): return self._binary(OperatorType.EW_MUL, a, b, name)
+    def divide(self, a, b, name=None): return self._binary(OperatorType.EW_DIV, a, b, name)
+    def max(self, a, b, name=None): return self._binary(OperatorType.EW_MAX, a, b, name)
+    def min(self, a, b, name=None): return self._binary(OperatorType.EW_MIN, a, b, name)
+
+    # ---- shape / misc ------------------------------------------------------
+    def concat(self, tensors: Sequence[Tensor], axis: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.CONCAT, list(tensors), dict(axis=axis), name)
+        return self._finish(layer)
+
+    def split(self, input: Tensor, sizes, axis: int, name=None):
+        if isinstance(sizes, int):
+            sizes = [input.shape[axis] // sizes] * sizes
+        layer = self._add_layer(OperatorType.SPLIT, [input],
+                                dict(sizes=tuple(sizes), axis=axis), name)
+        return self._finish(layer)
+
+    def reshape(self, input: Tensor, shape, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.RESHAPE, [input], dict(shape=tuple(shape)), name)
+        return self._finish(layer)
+
+    def transpose(self, input: Tensor, perm, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.TRANSPOSE, [input], dict(perm=tuple(perm)), name)
+        return self._finish(layer)
+
+    def flat(self, input: Tensor, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.FLAT, [input], {}, name)
+        return self._finish(layer)
+
+    def reverse(self, input: Tensor, axis: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REVERSE, [input], dict(axis=axis), name)
+        return self._finish(layer)
+
+    def cast(self, input: Tensor, dtype: DataType, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.CAST, [input], dict(dtype=dtype), name, dtype)
+        return self._finish(layer)
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.DROPOUT, [input], dict(rate=rate, seed=seed), name)
+        return self._finish(layer)
+
+    def softmax(self, input: Tensor, axis: int = -1, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.SOFTMAX, [input], dict(axis=axis), name)
+        return self._finish(layer)
+
+    def gather(self, input: Tensor, index: Tensor, axis: int = 0, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.GATHER, [input, index], dict(axis=axis), name)
+        return self._finish(layer)
+
+    def batch_matmul(self, a: Tensor, b: Tensor, a_seq_length_dim: int = -1,
+                     b_seq_length_dim: int = -1, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.BATCHMATMUL, [a, b], dict(
+            a_seq_length_dim=a_seq_length_dim, b_seq_length_dim=b_seq_length_dim), name)
+        return self._finish(layer)
+
+    def reduce_sum(self, input: Tensor, axes, keepdims: bool = False, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REDUCE_SUM, [input],
+                                dict(axes=tuple(axes), keepdims=keepdims), name)
+        return self._finish(layer)
+
+    def mean(self, input: Tensor, dims, keepdims: bool = False, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.MEAN, [input],
+                                dict(axes=tuple(dims), keepdims=keepdims), name)
+        return self._finish(layer)
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name=None):
+        layer = self._add_layer(OperatorType.TOPK, [input], dict(k=k, sorted=sorted), name)
+        return self._finish(layer)
+
+    def arg_top_k(self, input: Tensor, k: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.ARG_TOPK, [input], dict(k=k), name)
+        return self._finish(layer)
+
+    # ---- MoE ---------------------------------------------------------------
+    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float = 1.0,
+                 name=None):
+        layer = self._add_layer(OperatorType.GROUP_BY, [input, assign],
+                                dict(n=n, alpha=alpha), name)
+        return self._finish(layer)
+
+    def aggregate(self, inputs: Sequence[Tensor], n: int, lambda_bal: float = 0.0,
+                  name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.AGGREGATE, list(inputs),
+                                dict(n=n, lambda_bal=lambda_bal), name)
+        return self._finish(layer)
+
+    def aggregate_spec(self, inputs: Sequence[Tensor], n: int,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.AGGREGATE_SPEC, list(inputs),
+                                dict(n=n, lambda_bal=lambda_bal), name)
+        return self._finish(layer)
+
+    def cache(self, input: Tensor, num_batches: int = 1, score_fn=None, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.CACHE, [input],
+                                dict(num_batches=num_batches, score_fn=score_fn), name)
+        return self._finish(layer)
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int,
+            expert_hidden_size: int, alpha: float = 2.0,
+            lambda_bal: float = 0.04, name=None) -> Tensor:
+        """MoE sugar layer (model.h:507-512): softmax gate -> topk ->
+        group_by -> per-expert dense -> aggregate."""
+        gate = self.dense(input, num_exp, name=f"{name or 'moe'}_gate")
+        gate = self.softmax(gate)
+        topk_out = self.top_k(gate, num_select)
+        topk_values, topk_assign = topk_out
+        grouped = self.group_by(input, topk_assign, num_exp, alpha,
+                                name=f"{name or 'moe'}_group_by")
+        if num_exp == 1:
+            grouped = (grouped,)
+        expert_outs = []
+        for e in range(num_exp):
+            h = self.dense(grouped[e], expert_hidden_size,
+                           activation=ActiMode.AC_MODE_RELU,
+                           name=f"{name or 'moe'}_expert{e}_h")
+            o = self.dense(h, input.shape[-1], name=f"{name or 'moe'}_expert{e}_o")
+            expert_outs.append(o)
+        return self.aggregate(
+            [topk_values, topk_assign, topk_assign, gate] + expert_outs,
+            num_exp, lambda_bal, name=f"{name or 'moe'}_aggregate")
+
+    # ======================= compile ========================================
+    def compile(self, optimizer: Optional[Optimizer] = None,
+                loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Sequence[MetricsType] = (),
+                comp_mode: CompMode = CompMode.TRAINING,
+                machine_spec: Optional[MachineSpec] = None,
+                mesh=None) -> None:
+        """Materialize ops, choose a strategy, build jitted executables.
+
+        Mirrors FFModel::compile (model.cc:2802): Layer->Op materialization,
+        strategy search (or data-parallel default), then instead of Legion
+        region allocation + NCCL bootstrap, mesh construction + sharding
+        assignment + jit.
+        """
+        cfg = self.config
+        self.optimizer = optimizer or SGDOptimizer(
+            lr=cfg.learning_rate, weight_decay=cfg.weight_decay)
+        self.loss_type = loss_type
+        self.metrics = Metrics(loss_type, list(metrics))
+
+        # --- create_operators_from_layers (model.cc:2784) ---
+        nodes: List[OpNode] = []
+        tensor_ref: Dict[int, Tuple] = {}  # Tensor.guid -> ref
+        input_names: List[str] = []
+        for layer in self.layers:
+            if layer.op_type == OperatorType.INPUT:
+                t = layer.outputs[0]
+                tensor_ref[t.guid] = ("input", layer.name)
+                input_names.append(layer.name)
+                continue
+            op = OpRegistry.create(layer, [t.shape for t in layer.inputs])
+            refs = [tensor_ref[t.guid] for t in layer.inputs]
+            node = OpNode(op, refs)
+            nodes.append(node)
+            for i, t in enumerate(layer.outputs):
+                tensor_ref[t.guid] = ("op", op.guid, i)
+
+        if not nodes:
+            raise ValueError("model has no layers")
+        final_node = nodes[-1]
+        self._final_is_softmax = final_node.op.op_type == OperatorType.SOFTMAX
+
+        # --- machine + mesh ---
+        avail = len(jax.devices())
+        # num_devices == 0 means "auto: use every visible device"
+        n_dev = min(cfg.num_devices, avail) if cfg.num_devices > 0 else avail
+        batch0 = self.input_tensors[0].shape[0] if self.input_tensors else 1
+        self.machine_spec = machine_spec or detect_machine_spec(n_dev)
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            if cfg.enable_parameter_parallel and not cfg.only_data_parallel:
+                mp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+            else:
+                mp = 1
+            dp = n_dev // mp
+            while dp > 1 and batch0 % dp != 0:
+                dp //= 2
+            axes = {"data": dp}
+            if mp > 1:
+                axes["model"] = mp
+            self.mesh = make_mesh(dp * mp, axes)
+
+        # --- strategy selection ---
+        from flexflow_tpu.parallel.strategy import (
+            data_parallel_strategy, apply_strategy, search_strategy,
+            tensor_parallel_overrides)
+        if cfg.only_data_parallel or cfg.search_budget <= 0:
+            self.strategy = data_parallel_strategy(nodes, self.mesh)
+            if cfg.enable_parameter_parallel:
+                self.strategy = tensor_parallel_overrides(
+                    nodes, self.mesh, self.strategy)
+        else:
+            self.strategy = search_strategy(
+                nodes, self.mesh, self.machine_spec, cfg)
+        apply_strategy(nodes, self.strategy, self.mesh)
+
+        compute_dtype = (
+            jnp.bfloat16 if (cfg.allow_mixed_precision and
+                             self.machine_spec.chip != "cpu-sim")
+            else jnp.float32
+        )
+        data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
+        self.executor = GraphExecutor(
+            nodes, input_names, final_node.op.guid, self.mesh, loss_type,
+            self.metrics, self.optimizer, compute_dtype=compute_dtype,
+            data_axes=data_axes or ("data",),
+            final_is_softmax=self._final_is_softmax,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.state = self.executor.init_params_and_state(sub)
+        self.opt_state = self.optimizer.init(self.params)
+        self._iter = 0
+
+    # ======================= data staging ==================================
+    def _shard_batch(self, arr: np.ndarray) -> jax.Array:
+        sharding = NamedSharding(self.mesh, P(self.executor.data_axes))
+        return jax.device_put(jnp.asarray(arr), sharding)
+
+    def _stage_inputs(self, xs) -> Dict[str, jax.Array]:
+        if not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        names = self.executor.input_names
+        if len(xs) != len(names):
+            raise ValueError(f"model has {len(names)} inputs, got {len(xs)} arrays")
+        return {n: self._shard_batch(x) for n, x in zip(names, xs)}
+
+    # ======================= train / eval loops ============================
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, verbose: bool = True):
+        """Keras-style whole-dataset training loop
+        (base_model.py:376-430 / flexflow_cffi.py:2073-2086)."""
+        cfg = self.config
+        epochs = epochs or cfg.epochs
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        n = xs[0].shape[0]
+        bs = batch_size or self.input_tensors[0].shape[0]
+        train_step = self.executor.make_train_step()
+        num_batches = n // bs
+        if num_batches == 0:
+            raise ValueError(
+                f"dataset of {n} samples is smaller than batch size {bs}")
+        start = time.time()
+        for epoch in range(epochs):
+            self._metrics_acc = PerfMetrics()
+            mtotals = None  # on-device metric sums; host sync once per epoch
+            for b in range(num_batches):
+                sl = slice(b * bs, (b + 1) * bs)
+                inputs = self._stage_inputs([xx[sl] for xx in xs])
+                labels = self._shard_batch(y[sl])
+                self._rng, sub = jax.random.split(self._rng)
+                (self.params, self.opt_state, self.state, loss, mvals) = train_step(
+                    self.params, self.opt_state, self.state, inputs, labels, sub)
+                self._iter += 1
+                mtotals = mvals if mtotals is None else jax.tree.map(
+                    jnp.add, mtotals, mvals)
+            self._metrics_acc.update(
+                {k: v for k, v in (mtotals or {}).items()}, bs * num_batches)
+            if verbose:
+                rep = self._metrics_acc.report()
+                print(f"epoch {epoch}: loss={float(loss):.4f} " +
+                      " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
+        elapsed = time.time() - start
+        thr = n * epochs / elapsed
+        if verbose:
+            print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
+        return thr
+
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        n = xs[0].shape[0]
+        bs = batch_size or self.input_tensors[0].shape[0]
+        eval_step = self.executor.make_eval_step()
+        acc = PerfMetrics()
+        loss_sum, batches = 0.0, 0
+        for b in range(n // bs):
+            sl = slice(b * bs, (b + 1) * bs)
+            inputs = self._stage_inputs([xx[sl] for xx in xs])
+            labels = self._shard_batch(y[sl])
+            loss, logits, mvals = eval_step(self.params, self.state, inputs, labels)
+            loss_sum += float(loss)
+            batches += 1
+            acc.update({k: v for k, v in mvals.items()}, bs)
+        rep = acc.report()
+        rep["loss"] = loss_sum / max(batches, 1)
+        return rep
+
+    def predict(self, x):
+        fwd = self.executor.make_forward(training=False)
+        inputs = self._stage_inputs(x if isinstance(x, (list, tuple)) else [x])
+        self._rng, sub = jax.random.split(self._rng)
+        out, _ = fwd(self.params, self.state, inputs, sub)
+        return np.asarray(out)
+
+    # ---- reference-parity iteration protocol ------------------------------
+    # (forward / zero_gradients / backward / update — model.cc:2415-2475.
+    # Under XLA these are phases of one fused jitted step; we keep the API
+    # by staging the batch in forward() and running the fused step in
+    # update(). begin/end_trace are no-ops: jit IS the trace.)
+    def set_batch(self, x, y):
+        self._current_batch = (self._stage_inputs(x if isinstance(x, (list, tuple)) else [x]),
+                               self._shard_batch(y))
+
+    def forward(self, seq_length: Optional[int] = None):
+        if self._current_batch is None:
+            raise ValueError("call set_batch(x, y) before forward()")
+        self._pending = "forward"
+
+    def zero_gradients(self):
+        pass
+
+    def backward(self, seq_length: Optional[int] = None):
+        self._pending = "backward"
+
+    def update(self):
+        inputs, labels = self._current_batch
+        train_step = self.executor.make_train_step()
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params, self.opt_state, self.state, self._last_loss, self._last_metrics) = \
+            train_step(self.params, self.opt_state, self.state, inputs, labels, sub)
+        self._iter += 1
+        self._pending = None
+
+    def begin_trace(self, trace_id: int = 0):
+        pass
+
+    def end_trace(self, trace_id: int = 0):
+        pass
+
+    # ---- weight I/O (parallel_tensor.h:164-169 set_tensor/get_tensor) -----
+    def get_parameter(self, layer_name: str, param_name: str = "kernel") -> np.ndarray:
+        return np.asarray(self.params[layer_name][param_name])
+
+    def set_parameter(self, layer_name: str, value: np.ndarray,
+                      param_name: str = "kernel") -> None:
+        old = self.params[layer_name][param_name]
+        if tuple(old.shape) != tuple(value.shape):
+            raise ValueError(f"shape mismatch {old.shape} vs {value.shape}")
+        self.params[layer_name][param_name] = jax.device_put(
+            jnp.asarray(value, old.dtype), old.sharding)
+
+    def get_layer_names(self) -> List[str]:
+        return [n.op.name for n in (self.executor.nodes if self.executor else [])]

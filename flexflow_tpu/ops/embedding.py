@@ -1,0 +1,55 @@
+"""Embedding.
+
+Analog of src/ops/embedding.cc (+ kernels): aggregation modes SUM/AVG/NONE
+over a bag of token ids. The vocab (or output) dim of the weight is the
+parameter-parallel shardable axis used by DLRM-style strategies.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import AggrMode, OperatorType
+from flexflow_tpu.initializers import DefaultWeightInitializer
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+@register_op(OperatorType.EMBEDDING)
+class Embedding(Op):
+    """input ids [B, S](int) -> [B, out_dim] (SUM/AVG over S) or
+    [B, S, out_dim] (AGGR_MODE_NONE)."""
+
+    def __init__(self, layer, input_shapes):
+        p = layer.properties
+        self.num_entries = p["num_entries"]
+        self.out_dim = p["out_dim"]
+        self.aggr = p.get("aggr", AggrMode.AGGR_MODE_NONE)
+        self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        in_shape = self.input_shapes[0]
+        if self.aggr == AggrMode.AGGR_MODE_NONE:
+            return [tuple(in_shape) + (self.out_dim,)]
+        return [tuple(in_shape[:-1]) + (self.out_dim,)]
+
+    def init_params(self, rng):
+        return {"kernel": self.kernel_init(rng, (self.num_entries, self.out_dim))}
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (ids,) = inputs
+        emb = jnp.take(params["kernel"], ids.astype(jnp.int32), axis=0)
+        if self.aggr == AggrMode.AGGR_MODE_SUM:
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr == AggrMode.AGGR_MODE_AVG:
+            emb = jnp.mean(emb, axis=-2)
+        return [emb]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 2) + [DimRole.CHANNEL]
+        return [tuple(roles)]
+
+    def params_elems(self):
+        return self.num_entries * self.out_dim

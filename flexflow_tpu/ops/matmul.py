@@ -1,0 +1,51 @@
+"""BatchMatmul.
+
+Analog of src/ops/batch_matmul.cc (cuBLAS strided-batched GEMM). The
+reference threads FFIterationConfig::seq_length through
+a_seq_length_dim/b_seq_length_dim so short batches skip compute
+(model.h:481-485); here ctx.seq_length slices the corresponding dim before
+the einsum — under jit with a fixed seq_length this is a static slice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+@register_op(OperatorType.BATCHMATMUL)
+class BatchMatmul(Op):
+    """a: [..., M, K] @ b: [..., K, N] -> [..., M, N]."""
+
+    def __init__(self, layer, input_shapes):
+        self.a_seq_length_dim = layer.get_property("a_seq_length_dim", -1)
+        self.b_seq_length_dim = layer.get_property("b_seq_length_dim", -1)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        a, b = self.input_shapes
+        assert a[-1] == b[-2], f"batch_matmul contraction mismatch {a} @ {b}"
+        return [tuple(a[:-1]) + (b[-1],)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        a, b = inputs
+        if ctx.seq_length is not None:
+            if self.a_seq_length_dim >= 0:
+                a = jnp.take(a, jnp.arange(ctx.seq_length), axis=self.a_seq_length_dim)
+            if self.b_seq_length_dim >= 0:
+                b = jnp.take(b, jnp.arange(ctx.seq_length), axis=self.b_seq_length_dim)
+        cd = ctx.compute_dtype
+        y = jnp.matmul(a.astype(cd), b.astype(cd), preferred_element_type=jnp.float32)
+        return [y.astype(inputs[0].dtype)]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        return [tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))]
+
+    def flops(self):
+        a, b = self.input_shapes
+        batch = int(np.prod(a[:-2]))
+        return 2 * batch * a[-2] * a[-1] * b[-1]

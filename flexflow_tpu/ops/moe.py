@@ -1,0 +1,177 @@
+"""Mixture-of-Experts ops: GroupBy, Aggregate, AggregateSpec, Cache.
+
+Analogs of src/ops/{group_by,aggregate,aggregate_spec,cache}.cc/.cu.
+TPU re-design: the reference scatters tokens into per-expert CUDA buffers
+with dynamic counts; under XLA everything must be static-shape, so dispatch
+is expressed GShard-style — one-hot dispatch/combine tensors with a fixed
+per-expert capacity (capacity factor `alpha`, same knob as the reference's
+Group_by alpha) — lowered to einsums on the MXU, and to all_to_all over the
+'expert' mesh axis when experts are sharded (see parallel/expert.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+def expert_capacity(batch: int, k: int, n_experts: int, alpha: float) -> int:
+    return max(1, int(math.ceil(alpha * k * batch / n_experts)))
+
+
+def make_dispatch_tensors(assign, gates, n_experts: int, capacity: int):
+    """assign [B,K] int, gates [B,K] -> dispatch [B,K,E,C] bool-ish f32,
+    combine [B,K,E,C] f32 (gate-weighted), overflow dropped."""
+    b, k = assign.shape
+    expert_onehot = jax.nn.one_hot(assign, n_experts, dtype=jnp.float32)  # [B,K,E]
+    flat = expert_onehot.reshape(b * k, n_experts)
+    # position of each (token, slot) within its expert, in flat order
+    pos = jnp.cumsum(flat, axis=0) * flat - flat  # [B*K, E], 0-based
+    pos = pos.reshape(b, k, n_experts)
+    in_cap = pos < capacity
+    pos_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = expert_onehot[..., None] * pos_onehot * in_cap[..., None]
+    combine = dispatch * gates[..., None, None]
+    return dispatch, combine
+
+
+@register_op(OperatorType.GROUP_BY)
+class GroupBy(Op):
+    """inputs: (data [B,D], assign [B,K]) -> n_experts tensors [C, D].
+
+    Reference Group_by (src/ops/group_by.cu) writes variable-count rows per
+    expert buffer sized alpha*K*B/n; we produce fixed-capacity buffers via
+    the dispatch einsum (overflowed tokens drop, as in the reference).
+    """
+
+    def __init__(self, layer, input_shapes):
+        self.n_experts = layer.get_property("n")
+        self.alpha = layer.get_property("alpha", 1.0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        data, assign = self.input_shapes
+        b, k = assign
+        cap = expert_capacity(b, k, self.n_experts, self.alpha)
+        return [(cap, data[-1])] * self.n_experts
+
+    def forward(self, params, inputs, ctx: OpContext):
+        data, assign = inputs
+        b, k = assign.shape
+        cap = expert_capacity(b, k, self.n_experts, self.alpha)
+        dispatch, _ = make_dispatch_tensors(
+            assign, jnp.ones(assign.shape, jnp.float32), self.n_experts, cap
+        )
+        grouped = jnp.einsum("bd,bkec->ecd", data.astype(jnp.float32), dispatch)
+        return [grouped[e].astype(data.dtype) for e in range(self.n_experts)]
+
+    def output_dim_roles(self):
+        return [(DimRole.OTHER, DimRole.CHANNEL)] * self.n_experts
+
+
+@register_op(OperatorType.AGGREGATE)
+class Aggregate(Op):
+    """inputs: (gate_preds [B,K], gate_assign [B,K], true_gate_assign [B,K],
+    gate_grads [B,K], expert_out_0 [C,D] ... expert_out_{n-1}) -> [B,D].
+
+    Matches the reference's 4+n input signature (src/ops/aggregate.cc) —
+    the two extra assign/grad inputs exist for the load-balance loss path;
+    autodiff handles the gate gradient here so they are accepted and the
+    lb loss is exposed via aggregate load stats.
+    """
+
+    def __init__(self, layer, input_shapes):
+        self.n_experts = layer.get_property("n")
+        self.lambda_bal = layer.get_property("lambda_bal", 0.0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        b, k = self.input_shapes[0]
+        d = self.input_shapes[-1][-1]
+        return [(b, d)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        expert_outs = inputs[-self.n_experts:]
+        b, k = gate_assign.shape
+        cap = expert_outs[0].shape[0]
+        _, combine = make_dispatch_tensors(
+            gate_assign, gate_preds.astype(jnp.float32), self.n_experts, cap
+        )
+        stacked = jnp.stack(expert_outs, axis=0).astype(jnp.float32)  # [E,C,D]
+        out = jnp.einsum("bkec,ecd->bd", combine, stacked)
+        if self.lambda_bal > 0.0 and len(inputs) >= 4 + self.n_experts:
+            # load-balance auxiliary loss (the reference folds this into
+            # Aggregate's gate gradient, aggregate.cu): E * <f, P> with
+            # f = token fraction per expert, P = mean router probability;
+            # inputs[3] is the full gate output [B, E] from the moe sugar.
+            full_gate = inputs[3].astype(jnp.float32)
+            f = jnp.mean(
+                jax.nn.one_hot(gate_assign[:, 0], self.n_experts), axis=0
+            )
+            p_mean = jnp.mean(full_gate, axis=0)
+            self._aux_loss = self.lambda_bal * self.n_experts * jnp.sum(f * p_mean)
+        return [out.astype(expert_outs[0].dtype)]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL)]
+
+
+@register_op(OperatorType.AGGREGATE_SPEC)
+class AggregateSpec(Op):
+    """Speculative aggregate (src/ops/aggregate_spec.cc): same combine but
+    experts received *all* K assignments; output matches Aggregate."""
+
+    def __init__(self, layer, input_shapes):
+        self.n_experts = layer.get_property("n")
+        self.lambda_bal = layer.get_property("lambda_bal", 0.0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        b, k = self.input_shapes[0]
+        d = self.input_shapes[-1][-1]
+        return [(b, d)]
+
+    forward = Aggregate.forward
+    output_dim_roles = Aggregate.output_dim_roles
+
+
+@register_op(OperatorType.CACHE)
+class Cache(Op):
+    """Activation/score cache (src/ops/cache.cc): stores the input tensor
+    across iterations; a user-provided score function decides whether the
+    cached value is fresh enough to reuse. State lives in the model's
+    non-trainable state collection; under jit the trigger works on
+    materialized scores (host callback-free: score is returned as a metric).
+    """
+
+    def __init__(self, layer, input_shapes):
+        self.num_batches = layer.get_property("num_batches", 1)
+        self.score_fn = layer.get_property("score_fn")
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def init_state(self):
+        return {
+            "cached": jnp.zeros(self.input_shapes[0]),
+            "score": jnp.zeros(()),
+        }
+
+    def forward(self, params, inputs, ctx: OpContext, state=None):
+        (x,) = inputs
+        if state is not None:
+            score = (
+                self.score_fn(state["cached"], x)
+                if self.score_fn is not None
+                else jnp.mean((state["cached"] - x) ** 2)
+            )
+            self._new_state = {"cached": x, "score": score}
+        return [x]

@@ -1,0 +1,88 @@
+"""LayerNorm, Softmax, Dropout.
+
+Analogs of src/ops/layer_norm.cc/.cu, softmax.cc (cuDNN softmax),
+dropout.cc (cuDNN dropout). All are single fused XLA computations; the
+reference's custom Welford CUDA kernels are unnecessary — XLA fuses the
+mean/var reductions with the affine apply.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+@register_op(OperatorType.LAYERNORM)
+class LayerNorm(Op):
+    def __init__(self, layer, input_shapes):
+        self.axes = tuple(layer.get_property("axes", (-1,)))
+        self.elementwise_affine = layer.get_property("elementwise_affine", True)
+        self.eps = layer.get_property("eps", 1e-5)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def _norm_shape(self):
+        shp = self.input_shapes[0]
+        axes = tuple(a % len(shp) for a in self.axes)
+        return tuple(shp[a] for a in sorted(axes))
+
+    def init_params(self, rng):
+        if not self.elementwise_affine:
+            return {}
+        ns = self._norm_shape()
+        return {"scale": jnp.ones(ns), "bias": jnp.zeros(ns)}
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=self.axes, keepdims=True)
+        var = jnp.var(xf, axis=self.axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.elementwise_affine:
+            y = y * params["scale"] + params["bias"]
+        return [y.astype(x.dtype)]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 1)
+        return [tuple(roles)]
+
+    def params_elems(self):
+        return 2 * int(np.prod(self._norm_shape())) if self.elementwise_affine else 0
+
+
+@register_op(OperatorType.SOFTMAX)
+class Softmax(Op):
+    def __init__(self, layer, input_shapes):
+        self.axis = layer.get_property("axis", -1)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        return [jax.nn.softmax(x.astype(jnp.float32), axis=self.axis).astype(x.dtype)]
+
+
+@register_op(OperatorType.DROPOUT)
+class Dropout(Op):
+    def __init__(self, layer, input_shapes):
+        self.rate = layer.get_property("rate", 0.5)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        if not ctx.training or self.rate <= 0.0:
+            return [x]
+        keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - self.rate, x.shape)
+        return [jnp.where(keep, x / (1.0 - self.rate), 0).astype(x.dtype)]

@@ -1,0 +1,80 @@
+"""ReduceSum / Mean / TopK / ArgTopK.
+
+Analogs of src/ops/{reduce,mean,topk}.cc/.cu. TopK uses lax.top_k (TPU
+sort-based) instead of the reference's custom GPU heap kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+def _reduced_shape(shape, axes, keepdims):
+    axes = tuple(a % len(shape) for a in axes)
+    if keepdims:
+        return tuple(1 if i in axes else s for i, s in enumerate(shape))
+    return tuple(s for i, s in enumerate(shape) if i not in axes)
+
+
+@register_op(OperatorType.REDUCE_SUM)
+class ReduceSum(Op):
+    def __init__(self, layer, input_shapes):
+        self.axes = tuple(layer.get_property("axes"))
+        self.keepdims = layer.get_property("keepdims", False)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [_reduced_shape(self.input_shapes[0], self.axes, self.keepdims)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.sum(inputs[0], axis=self.axes, keepdims=self.keepdims)]
+
+
+@register_op(OperatorType.MEAN)
+class Mean(Op):
+    def __init__(self, layer, input_shapes):
+        self.axes = tuple(layer.get_property("axes"))
+        self.keepdims = layer.get_property("keepdims", False)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [_reduced_shape(self.input_shapes[0], self.axes, self.keepdims)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.mean(inputs[0], axis=self.axes, keepdims=self.keepdims)]
+
+
+@register_op(OperatorType.TOPK)
+class TopK(Op):
+    """Returns (values, indices) of the k largest along the last dim."""
+
+    def __init__(self, layer, input_shapes):
+        self.k = layer.get_property("k")
+        self.sorted = layer.get_property("sorted", True)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        s = tuple(self.input_shapes[0][:-1]) + (self.k,)
+        return [s, s]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        vals, idx = lax.top_k(inputs[0], self.k)
+        return [vals, idx]
+
+
+@register_op(OperatorType.ARG_TOPK)
+class ArgTopK(Op):
+    def __init__(self, layer, input_shapes):
+        self.k = layer.get_property("k")
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [tuple(self.input_shapes[0][:-1]) + (self.k,)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        _, idx = lax.top_k(inputs[0], self.k)
+        return [idx]

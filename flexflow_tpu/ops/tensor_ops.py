@@ -1,0 +1,136 @@
+"""Shape/layout ops: Concat, Split, Reshape, Transpose, Reverse, Cast, Gather.
+
+Analogs of src/ops/{concat,split,reshape,transpose,reverse,cast,gather}.cc.
+All are pure XLA data-movement ops (often layout-only after fusion).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+def _default_roles(shp):
+    return tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))
+
+
+@register_op(OperatorType.CONCAT)
+class Concat(Op):
+    def __init__(self, layer, input_shapes):
+        self.axis = layer.get_property("axis", 0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        ax = self.axis % len(self.input_shapes[0])
+        out = list(self.input_shapes[0])
+        out[ax] = sum(s[ax] for s in self.input_shapes)
+        return [tuple(out)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.concatenate(inputs, axis=self.axis)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.SPLIT)
+class Split(Op):
+    def __init__(self, layer, input_shapes):
+        self.sizes = tuple(layer.get_property("sizes"))
+        self.axis = layer.get_property("axis", 0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        ax = self.axis % len(self.input_shapes[0])
+        outs = []
+        for sz in self.sizes:
+            s = list(self.input_shapes[0])
+            s[ax] = sz
+            outs.append(tuple(s))
+        return outs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        idx = np.cumsum(self.sizes)[:-1]
+        return list(jnp.split(x, idx, axis=self.axis))
+
+    def output_dim_roles(self):
+        return [_default_roles(s) for s in self.output_shapes]
+
+
+@register_op(OperatorType.RESHAPE)
+class Reshape(Op):
+    def __init__(self, layer, input_shapes):
+        self.target = tuple(layer.get_property("shape"))
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.target]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0].reshape(self.target)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.TRANSPOSE)
+class Transpose(Op):
+    def __init__(self, layer, input_shapes):
+        self.perm = tuple(layer.get_property("perm"))
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        s = self.input_shapes[0]
+        return [tuple(s[p] for p in self.perm)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.transpose(inputs[0], self.perm)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.REVERSE)
+class Reverse(Op):
+    def __init__(self, layer, input_shapes):
+        self.axis = layer.get_property("axis", 0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.flip(inputs[0], axis=self.axis)]
+
+
+@register_op(OperatorType.CAST)
+class Cast(Op):
+    def __init__(self, layer, input_shapes):
+        self.target_dtype: DataType = layer.get_property("dtype")
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0].astype(self.target_dtype.jnp_dtype)]
+
+
+@register_op(OperatorType.GATHER)
+class Gather(Op):
+    """take_along_axis gather (src/ops/gather.cc): out[idx] along dim."""
+
+    def __init__(self, layer, input_shapes):
+        self.axis = layer.get_property("axis", 0)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[1]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x, idx = inputs
+        return [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=self.axis)]

@@ -1,0 +1,1 @@
+"""Parallelism layer: PCG, parallel (resharding) ops, strategies, collectives."""

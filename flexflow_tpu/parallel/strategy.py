@@ -1,0 +1,99 @@
+"""Parallelization strategies: per-op sharding assignment over the mesh.
+
+The reference expresses a strategy as per-op MachineViews + the four
+resharding ops inserted in the PCG (SURVEY §2.3); on TPU a strategy is a
+map op-guid -> OpStrategy{output PartitionSpecs, param PartitionSpecs}.
+GSPMD then inserts the collectives that the reference's
+Repartition/Combine/Replicate/Reduction ops perform explicitly.
+
+``data_parallel_strategy`` is the analog of
+``--only-data-parallel`` (graph.cc:1939-1964): batch dim of every
+activation sharded over the 'data' axis, parameters replicated (their
+gradient psum is the NCCL allreduce analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole
+
+
+@dataclasses.dataclass
+class OpStrategy:
+    output_specs: List[Optional[P]]
+    param_specs: Dict[str, P] = dataclasses.field(default_factory=dict)
+
+
+Strategy = Dict[int, OpStrategy]
+
+
+def _axis_size(mesh, name: str) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
+
+
+def data_parallel_strategy(nodes, mesh) -> Strategy:
+    dp = _axis_size(mesh, "data")
+    strategy: Strategy = {}
+    for node in nodes:
+        specs = []
+        for shp, roles in zip(node.op.output_shapes, node.op.output_dim_roles()):
+            if (dp > 1 and shp and roles and roles[0] == DimRole.SAMPLE
+                    and shp[0] % dp == 0):
+                specs.append(P("data", *([None] * (len(shp) - 1))))
+            else:
+                specs.append(None)
+        strategy[node.op.guid] = OpStrategy(output_specs=specs)
+    return strategy
+
+
+def tensor_parallel_overrides(nodes, mesh, strategy: Strategy) -> Strategy:
+    """Shard weight-heavy ops on the 'model' axis: Linear column-parallel
+    (kernel [in, out] -> out sharded), attention head-parallel, embedding
+    vocab-parallel. Analog of the parameter/attribute-parallel
+    substitutions (substitution.cc:1756-1809)."""
+    mp = _axis_size(mesh, "model")
+    if mp <= 1:
+        return strategy
+    for node in nodes:
+        op = node.op
+        st = strategy[op.guid]
+        if op.op_type == OperatorType.LINEAR and op.out_dim % mp == 0:
+            st.param_specs["kernel"] = P(None, "model")
+            st.param_specs["bias"] = P("model")
+            shp = op.output_shapes[0]
+            base = st.output_specs[0] or P(*([None] * len(shp)))
+            st.output_specs[0] = P(*list(base)[:-1], "model")
+        elif op.op_type == OperatorType.MULTIHEAD_ATTENTION and op.num_heads % mp == 0:
+            st.param_specs.update({
+                "wq": P("model", None, None),
+                "wk": P("model", None, None),
+                "wv": P("model", None, None),
+                "wo": P("model", None, None),
+            })
+        elif op.op_type == OperatorType.EMBEDDING and op.out_dim % mp == 0:
+            st.param_specs["kernel"] = P(None, "model")
+    return strategy
+
+
+def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
+    for node in nodes:
+        st = strategy.get(node.op.guid)
+        if st is None:
+            continue
+        node.output_specs = list(st.output_specs)
+        node.param_specs = dict(st.param_specs)
+
+
+def search_strategy(nodes, mesh, machine_spec, config) -> Strategy:
+    """Unity-style automatic strategy search. Falls back to DP until the
+    search stack (flexflow_tpu/search) decides otherwise."""
+    try:
+        from flexflow_tpu.search.unity import graph_optimize
+        return graph_optimize(nodes, mesh, machine_spec, config)
+    except ImportError:
+        return data_parallel_strategy(nodes, mesh)

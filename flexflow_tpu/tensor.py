@@ -1,0 +1,150 @@
+"""Logical tensors and parallel (sharded) tensor shapes.
+
+TPU re-design of the reference's two tensor levels
+(include/flexflow/parallel_tensor.h): a frontend-facing symbolic ``Tensor``
+produced by ``Layer``s, and a ``ParallelTensorShape`` whose per-dimension
+``ParallelDim{size, degree, ...}`` records how the PCG shards the tensor.
+Where the reference materializes Legion regions/partitions from the dims
+(parallel_tensor.cc), we lower degrees to a ``jax.sharding.PartitionSpec``
+over named mesh axes — the array itself lives inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One dimension of a parallel tensor.
+
+    ``size`` is the global extent; ``degree`` the number of shards along it;
+    ``mesh_axes`` the named mesh axes the shards map to (empty = unsharded);
+    ``is_replica_dim`` marks the synthetic leading replica dimension the PCG
+    adds to weights/inputs (parallel_tensor.h:36-44). A replica dim has
+    size == degree and no bytes of its own.
+    """
+
+    size: int
+    degree: int = 1
+    mesh_axes: Tuple[str, ...] = ()
+    is_replica_dim: bool = False
+
+    def __post_init__(self):
+        if self.size % max(self.degree, 1) != 0 and not self.is_replica_dim:
+            raise ValueError(
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+    @property
+    def shard_size(self) -> int:
+        return self.size // self.degree if not self.is_replica_dim else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + dtype + per-dim parallel degrees (parallel_tensor.h:76)."""
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT
+
+    @classmethod
+    def make(
+        cls,
+        sizes: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        degrees: Optional[Sequence[int]] = None,
+    ) -> "ParallelTensorShape":
+        degrees = degrees or [1] * len(sizes)
+        return cls(
+            tuple(ParallelDim(s, d) for s, d in zip(sizes, degrees)), dtype
+        )
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def degrees(self) -> Tuple[int, ...]:
+        return tuple(d.degree for d in self.dims)
+
+    @property
+    def num_replica(self) -> int:
+        return math.prod(d.degree for d in self.dims if d.is_replica_dim)
+
+    @property
+    def total_degree(self) -> int:
+        return math.prod(d.degree for d in self.dims)
+
+    def num_elements(self) -> int:
+        return math.prod(self.sizes) if self.sizes else 1
+
+    def shard_bytes(self) -> int:
+        n = 1
+        for d in self.dims:
+            if not d.is_replica_dim:
+                n *= d.shard_size
+        return n * self.dtype.size
+
+    def global_bytes(self) -> int:
+        return self.num_elements() * self.dtype.size
+
+    def partition_spec(self):
+        """Lower degrees to a ``jax.sharding.PartitionSpec`` (GSPMD)."""
+        from jax.sharding import PartitionSpec
+
+        entries = []
+        for d in self.dims:
+            if d.is_replica_dim:
+                continue
+            if not d.mesh_axes:
+                entries.append(None)
+            elif len(d.mesh_axes) == 1:
+                entries.append(d.mesh_axes[0])
+            else:
+                entries.append(tuple(d.mesh_axes))
+        return PartitionSpec(*entries)
+
+
+class Tensor:
+    """Frontend-facing symbolic tensor: shape, dtype, producing layer.
+
+    Analog of the reference's ``TensorBase`` (deferred graph level): no data
+    is attached until ``compile``; ``set_tensor/get_tensor`` host I/O is
+    provided on the owning model after compile.
+    """
+
+    _next_guid = [1000]
+
+    def __init__(
+        self,
+        shape: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        owner_layer=None,
+        owner_idx: int = 0,
+        name: Optional[str] = None,
+    ):
+        self.guid = Tensor._next_guid[0]
+        Tensor._next_guid[0] += 1
+        self.shape: Tuple[int, ...] = tuple(int(s) for s in shape)
+        self.dtype = dtype
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.name = name or f"tensor_{self.guid}"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def num_elements(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __repr__(self):
+        owner = self.owner_layer.name if self.owner_layer is not None else None
+        return f"Tensor({self.shape}, {self.dtype.value}, owner={owner})"

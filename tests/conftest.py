@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Analog of the reference's testing gap fix (SURVEY §4): JAX's CPU backend
+with xla_force_host_platform_device_count gives a free "fake TPU slice" so
+every functional + sharding test runs devicelessly.
+"""
+
+import os
+
+# Must run before jax initializes a backend. The sandbox pins
+# JAX_PLATFORMS=axon (TPU tunnel); jax.config.update overrides it.
+os.environ.pop("JAX_PLATFORMS", None)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
