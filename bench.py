@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Headline benchmark: BERT-proxy transformer training throughput.
+
+Protocol follows the reference's OSDI'22 AE BERT benchmark
+(scripts/osdi22ae/bert.sh + examples/cpp/Transformer/transformer.cc:79-84):
+12 layers, hidden 1024, 16 heads, seq 512, batch 8 per chip; metric is
+training samples/s (fwd+bwd+update, jitted). Prints ONE JSON line.
+
+vs_baseline: ratio against the recorded best from previous rounds
+(bench_history.json), 1.0 on first run — the reference repo publishes no
+absolute numbers (BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType, MetricsType
+    from flexflow_tpu.models.transformer import TransformerConfig, create_transformer
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                             seq_length=64, batch_size=8)
+           if on_cpu else TransformerConfig())  # reference config on TPU
+
+    from flexflow_tpu.optimizers import AdamOptimizer
+
+    ff = create_transformer(cfg, FFConfig(batch_size=cfg.batch_size))
+    ff.compile(AdamOptimizer(alpha=1e-4), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.MEAN_SQUARED_ERROR])
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, cfg.seq_length, cfg.hidden_size).astype(np.float32)
+    y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+
+    train_step = ff.executor.make_train_step()
+    inputs = ff._stage_inputs([x])
+    labels = ff._shard_batch(y)
+
+    import jax.random as jrandom
+
+    def step(params, opt_state, state, rng):
+        rng, sub = jrandom.split(rng)
+        params, opt_state, state, loss, _ = train_step(
+            params, opt_state, state, inputs, labels, sub)
+        return params, opt_state, state, rng, loss
+
+    params, opt_state, state = ff.params, ff.opt_state, ff.state
+    rng = jrandom.PRNGKey(0)
+    # warmup (compile); float() forces a real device->host sync — on the
+    # tunneled TPU backend block_until_ready alone does not.
+    for _ in range(3):
+        params, opt_state, state, rng, loss = step(params, opt_state, state, rng)
+    float(loss)
+
+    iters = 10 if on_cpu else 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, state, rng, loss = step(params, opt_state, state, rng)
+    final_loss = float(loss)  # sync: depends on the whole step chain
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"training diverged: loss={final_loss}"
+    samples_per_s = cfg.batch_size * iters / dt
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_history.json")
+    baseline = None
+    if os.path.exists(hist_path):
+        try:
+            baseline = json.load(open(hist_path)).get("samples_per_s")
+        except Exception:
+            baseline = None
+    vs_baseline = samples_per_s / baseline if baseline else 1.0
+    try:
+        # record the best-known number so vs_baseline is vs best, not last
+        json.dump({"samples_per_s": max(samples_per_s, baseline or 0.0),
+                   "config": dataclass_dict(cfg)}, open(hist_path, "w"))
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "bert_proxy_train_throughput",
+        "value": round(samples_per_s, 3),
+        "unit": "samples/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+def dataclass_dict(cfg):
+    import dataclasses
+    return dataclasses.asdict(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,6 @@ class FFConfig:
         not know are left for the application.
         """
         rest: List[str] = []
-        it = iter(range(len(argv)))
         i = 0
         args = list(argv)
 

@@ -52,9 +52,18 @@ class PerfMetrics:
 
 
 class Metrics:
-    def __init__(self, loss_type: LossType, metrics: List[MetricsType]):
+    def __init__(self, loss_type: LossType, metrics: List[MetricsType],
+                 preds_are_probs: bool = True):
         self.loss_type = loss_type
         self.metrics = list(metrics)
+        # False when the model's final op emits logits (no softmax): the
+        # CE metrics then normalize via log_softmax instead of log(p)
+        self.preds_are_probs = preds_are_probs
+
+    def _log_probs(self, preds: jax.Array) -> jax.Array:
+        if self.preds_are_probs:
+            return jnp.log(jnp.clip(preds.astype(jnp.float32), 1e-12, 1.0))
+        return jax.nn.log_softmax(preds.astype(jnp.float32), axis=-1)
 
     def compute(self, preds: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
         """Per-batch metric sums (not averaged), jit-traceable."""
@@ -71,11 +80,11 @@ class Metrics:
                     correct = (preds > 0.5).astype(jnp.int32).reshape(b, -1)[:, 0] == labels.reshape(b, -1)[:, 0]
                 out["accuracy"] = jnp.sum(correct.astype(jnp.int32))
             elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
-                logp = jnp.log(jnp.clip(preds.astype(jnp.float32), 1e-12, 1.0))
+                logp = self._log_probs(preds)
                 out["cce_loss"] = -jnp.sum(labels * logp)
             elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
                 lab = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
-                logp = jnp.log(jnp.clip(preds.astype(jnp.float32), 1e-12, 1.0))
+                logp = self._log_probs(preds)
                 out["sparse_cce_loss"] = -jnp.sum(
                     jnp.take_along_axis(logp, lab[:, None], axis=-1)
                 )

@@ -68,6 +68,12 @@ class FFModel:
                       create_grad: bool = True, name: Optional[str] = None) -> Tensor:
         layer = Layer(OperatorType.INPUT, name or f"input_{len(self.input_tensors)}",
                       [], data_type=dtype)
+        # input names key the feed dict — must be unique too
+        if not hasattr(self, "_used_names"):
+            self._used_names = set()
+        if layer.name in self._used_names:
+            layer.name = f"{layer.name}_{layer.guid}"
+        self._used_names.add(layer.name)
         t = Tensor(dims, dtype, owner_layer=layer, name=layer.name)
         layer.outputs = [t]
         self.layers.append(layer)
@@ -98,7 +104,6 @@ class FFModel:
             for i, s in enumerate(op.output_shapes)
         ]
         layer.outputs = outs
-        layer._op_proto = op  # cached; compile re-creates fresh ops
         return outs[0] if len(outs) == 1 else tuple(outs)
 
     # ---- dense / conv stack (model.h:380-520 API parity) -------------------
@@ -340,7 +345,6 @@ class FFModel:
         self.optimizer = optimizer or SGDOptimizer(
             lr=cfg.learning_rate, weight_decay=cfg.weight_decay)
         self.loss_type = loss_type
-        self.metrics = Metrics(loss_type, list(metrics))
 
         # --- create_operators_from_layers (model.cc:2784) ---
         nodes: List[OpNode] = []
@@ -363,6 +367,8 @@ class FFModel:
             raise ValueError("model has no layers")
         final_node = nodes[-1]
         self._final_is_softmax = final_node.op.op_type == OperatorType.SOFTMAX
+        self.metrics = Metrics(loss_type, list(metrics),
+                               preds_are_probs=self._final_is_softmax)
 
         # --- machine + mesh ---
         avail = len(jax.devices())
@@ -465,7 +471,7 @@ class FFModel:
                 print(f"epoch {epoch}: loss={float(loss):.4f} " +
                       " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
         elapsed = time.time() - start
-        thr = n * epochs / elapsed
+        thr = bs * num_batches * epochs / elapsed  # only samples actually trained
         if verbose:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
         return thr
@@ -474,6 +480,9 @@ class FFModel:
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
         bs = batch_size or self.input_tensors[0].shape[0]
+        if n // bs == 0:
+            raise ValueError(
+                f"dataset of {n} samples is smaller than batch size {bs}")
         eval_step = self.executor.make_eval_step()
         acc = PerfMetrics()
         loss_sum, batches = 0.0, 0

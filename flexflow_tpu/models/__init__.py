@@ -1,0 +1,20 @@
+"""Model zoo: the reference's example models rebuilt through the FFModel API.
+
+Mirrors examples/cpp/{Transformer,AlexNet,ResNet,InceptionV3,DLRM,XDL,
+mixture_of_experts,candle_uno,MLP_Unify,resnext50} with the same
+architecture configs, so benchmark protocols carry over (SURVEY §6).
+"""
+
+from flexflow_tpu.models.transformer import create_transformer, TransformerConfig
+from flexflow_tpu.models.mlp import create_mlp
+from flexflow_tpu.models.alexnet import create_alexnet
+from flexflow_tpu.models.dlrm import create_dlrm, DLRMConfig
+
+__all__ = [
+    "create_transformer",
+    "TransformerConfig",
+    "create_mlp",
+    "create_alexnet",
+    "create_dlrm",
+    "DLRMConfig",
+]

@@ -1,0 +1,30 @@
+"""AlexNet (examples/cpp/AlexNet/alexnet.cc): the reference's canonical
+CNN example, CIFAR/ImageNet NCHW."""
+
+from __future__ import annotations
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode, PoolType
+from flexflow_tpu.model import FFModel
+
+
+def create_alexnet(batch_size: int = 64, num_classes: int = 10,
+                   image_size: int = 224, ff_config: FFConfig = None) -> FFModel:
+    ff = FFModel(ff_config or FFConfig(batch_size=batch_size))
+    t = ff.create_tensor((batch_size, 3, image_size, image_size))
+    t = ff.conv2d(t, 64, 11, 11, 4, 4, 2, 2, activation=ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation=ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dropout(t, 0.5)
+    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dropout(t, 0.5)
+    t = ff.dense(t, num_classes)
+    t = ff.softmax(t)
+    return ff

@@ -1,0 +1,55 @@
+"""DLRM (examples/cpp/DLRM/dlrm.cc): sparse embedding tables + bottom/top
+MLPs + pairwise feature interaction. The embedding tables are the
+parameter-parallel showcase (shipped strategies
+examples/cpp/DLRM/strategies/)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode, AggrMode
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class DLRMConfig:
+    batch_size: int = 64
+    num_sparse_features: int = 8
+    vocab_size: int = 100000
+    embedding_dim: int = 64
+    indices_per_feature: int = 1
+    dense_dim: int = 16
+    bottom_mlp: Sequence[int] = (512, 256, 64)
+    top_mlp: Sequence[int] = (512, 256, 1)
+
+
+def create_dlrm(cfg: DLRMConfig, ff_config: FFConfig = None) -> FFModel:
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    from flexflow_tpu.ffconst import DataType
+
+    # sparse features -> embedding bags (SUM aggregated)
+    sparse_outs = []
+    for i in range(cfg.num_sparse_features):
+        ids = ff.create_tensor(
+            (cfg.batch_size, cfg.indices_per_feature), DataType.INT32,
+            name=f"sparse_{i}")
+        e = ff.embedding(ids, cfg.vocab_size, cfg.embedding_dim,
+                         aggr=AggrMode.AGGR_MODE_SUM, name=f"emb_{i}")
+        sparse_outs.append(e)
+
+    # dense features -> bottom MLP
+    dense_in = ff.create_tensor((cfg.batch_size, cfg.dense_dim), name="dense")
+    t = dense_in
+    for j, h in enumerate(cfg.bottom_mlp):
+        t = ff.dense(t, h, activation=ActiMode.AC_MODE_RELU, name=f"bot_{j}")
+
+    # feature interaction: concat embeddings + bottom output (dlrm.cc
+    # interact_features "cat" mode)
+    z = ff.concat(sparse_outs + [t], axis=1, name="interact")
+
+    for j, h in enumerate(cfg.top_mlp):
+        act = ActiMode.AC_MODE_RELU if j < len(cfg.top_mlp) - 1 else ActiMode.AC_MODE_SIGMOID
+        z = ff.dense(z, h, activation=act, name=f"top_{j}")
+    return ff

@@ -1,0 +1,65 @@
+"""Transformer / BERT-proxy model.
+
+Analog of examples/cpp/Transformer/transformer.cc: the OSDI'22 Unity BERT
+benchmark config is 12 layers, hidden 1024, 16 heads, seq 512, batch 8
+(transformer.cc:79-84); each layer = MHA + residual + 2-layer FFN
+(create_attention_encoder, transformer.cc:22-38; the reference omits
+layernorm — we include the standard pre-LN encoder as the TPU flagship and
+keep ``layer_norm=False`` parity mode for benchmark comparisons).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode, LossType, MetricsType
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    # reference defaults (transformer.cc:79-84)
+    num_layers: int = 12
+    hidden_size: int = 1024
+    num_heads: int = 16
+    seq_length: int = 512
+    batch_size: int = 8
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    layer_norm: bool = True  # False = exact reference block structure
+    causal: bool = False
+
+
+def create_transformer(cfg: TransformerConfig, ff_config: FFConfig = None) -> FFModel:
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    t = ff.create_tensor((cfg.batch_size, cfg.seq_length, cfg.hidden_size),
+                         name="input")
+    for i in range(cfg.num_layers):
+        # attention sublayer (+ residual)
+        a_in = ff.layer_norm(t, name=f"ln1_{i}") if cfg.layer_norm else t
+        a = ff.multihead_attention(
+            a_in, a_in, a_in, cfg.hidden_size, cfg.num_heads,
+            dropout=cfg.dropout, causal=cfg.causal, name=f"attn_{i}")
+        t = ff.add(t, a, name=f"res1_{i}")
+        # FFN sublayer (dense_relu + dense, transformer.cc:31-35)
+        f_in = ff.layer_norm(t, name=f"ln2_{i}") if cfg.layer_norm else t
+        h = ff.dense(f_in, cfg.hidden_size * cfg.ffn_mult,
+                     activation=ActiMode.AC_MODE_RELU, name=f"ffn1_{i}")
+        h = ff.dense(h, cfg.hidden_size, name=f"ffn2_{i}")
+        t = ff.add(t, h, name=f"res2_{i}")
+    # classification head as in the reference (dense to 1 output per token
+    # feature, transformer.cc:60-66 uses dense(hidden)->dense(1))
+    t = ff.dense(t, 1, name="head")
+    return ff
+
+
+def compile_transformer(cfg: TransformerConfig, ff_config: FFConfig = None,
+                        optimizer=None, mesh=None) -> FFModel:
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    ff = create_transformer(cfg, ff_config)
+    ff.compile(optimizer or SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.MEAN_SQUARED_ERROR], mesh=mesh)
+    return ff

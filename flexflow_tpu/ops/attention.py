@@ -114,7 +114,7 @@ class MultiHeadAttention(Op):
         b, sq, e = self.input_shapes[0]
         sk = self.input_shapes[1][1] if len(self.input_shapes) > 1 else sq
         h, d = self.num_heads, self.head_dim
-        proj = 2 * b * h * d * (sq * e + 2 * sk * self.kdim + sq * e)
+        proj = 2 * b * h * d * (sq * e + sk * self.kdim + sk * self.vdim + sq * e)
         core = 2 * b * h * sq * sk * d * 2
         return proj + core
 
