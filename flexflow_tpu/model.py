@@ -327,6 +327,31 @@ class FFModel:
             [topk_values, topk_assign, topk_assign, gate] + expert_outs,
             num_exp, lambda_bal, name=f"{name or 'moe'}_aggregate")
 
+    # ---- parallel (resharding) ops — explicit PCG API ---------------------
+    # (src/parallel_ops/*.cc; under XLA these become sharding-constraint
+    # boundaries — see flexflow_tpu/ops/parallel_ops.py)
+    def repartition(self, input: Tensor, dim: int, degree: int,
+                    axis: Optional[str] = None, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REPARTITION, [input], dict(
+            dim=dim, degree=degree,
+            axis=axis or ("data" if dim == 0 else "model")), name)
+        return self._finish(layer)
+
+    def combine(self, input: Tensor, dim: int, degree: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.COMBINE, [input],
+                                dict(dim=dim, degree=degree), name)
+        return self._finish(layer)
+
+    def replicate(self, input: Tensor, degree: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REPLICATE, [input],
+                                dict(degree=degree), name)
+        return self._finish(layer)
+
+    def reduction(self, input: Tensor, dim: int, degree: int, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REDUCTION, [input],
+                                dict(dim=dim, degree=degree), name)
+        return self._finish(layer)
+
     # ======================= compile ========================================
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,

@@ -17,5 +17,6 @@ import flexflow_tpu.ops.matmul  # noqa: F401
 import flexflow_tpu.ops.embedding  # noqa: F401
 import flexflow_tpu.ops.reduce  # noqa: F401
 import flexflow_tpu.ops.moe  # noqa: F401
+import flexflow_tpu.ops.parallel_ops  # noqa: F401
 
 __all__ = ["Op", "OpRegistry", "register_op"]

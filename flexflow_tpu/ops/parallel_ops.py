@@ -1,0 +1,197 @@
+"""Parallel (resharding) operators — first-class PCG citizens.
+
+TPU re-design of src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc (base ParallelOp, include/flexflow/parallel_ops/
+parallel_op.h:17). In the reference these ops *re-partition Legion
+regions*; under XLA they lower to ``with_sharding_constraint`` boundaries,
+and GSPMD inserts the collective that realizes the movement:
+
+* ``Repartition(dim, degree)`` → constrain output sharded on ``dim``
+  (scatter / collective-permute in GSPMD terms);
+* ``Combine(dim, degree)``      → constrain output unsharded on ``dim``
+  (all-gather over ICI);
+* ``Replicate(degree)``          → constrain fully replicated (broadcast);
+* ``Reduction(degree)``          → sum partial replicas (psum /
+  reduce-scatter). Under full-auto GSPMD partial-sum tensors never escape
+  an op, so Reduction sums an explicit leading replica dim instead —
+  semantically identical to the reference, where the replica dim is a real
+  tensor dim (parallel_tensor.h:40);
+* ``FusedParallelOp`` — a chain of the above collapsed to one constraint
+  (analog of fuse_parallel_ops, src/runtime/substitution.cc:1925).
+
+The mesh axis carrying each op's degree is resolved at strategy-application
+time; these ops also serve as user-facing manual overrides exactly like the
+reference's explicit API calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+
+
+class ParallelOpBase(Op):
+    """Common behavior: identity compute; sharding decided by strategy.
+
+    ``preferred_spec_update(spec_entries)`` lets each parallel op rewrite
+    the inherited PartitionSpec entries; the executor applies the result as
+    a constraint after forward.
+    """
+
+    is_parallel_op = True
+
+    def flops(self):
+        return 0
+
+    def output_dim_roles(self):
+        return [
+            tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(s)))
+            for s in self.output_shapes
+        ]
+
+
+@register_op(OperatorType.REPARTITION)
+class Repartition(ParallelOpBase):
+    """Split dim ``repartition_dim`` into ``repartition_degree`` shards
+    (src/parallel_ops/partition.cc:132)."""
+
+    def __init__(self, layer, input_shapes):
+        self.repartition_dim = layer.get_property("dim", 0)
+        self.repartition_degree = layer.get_property("degree", 1)
+        self.axis = layer.get_property("axis", None)  # resolved mesh axis
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        shp = self.input_shapes[0]
+        d = self.repartition_dim % len(shp)
+        if shp[d] % self.repartition_degree:
+            raise ValueError(
+                f"repartition: dim {d} size {shp[d]} not divisible by "
+                f"{self.repartition_degree}")
+        return [tuple(shp)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def preferred_spec_update(self, entries):
+        d = self.repartition_dim % len(self.output_shapes[0])
+        entries = list(entries)
+        entries[d] = self.axis
+        return entries
+
+
+@register_op(OperatorType.COMBINE)
+class Combine(ParallelOpBase):
+    """Gather shards of dim ``combine_dim`` back together — the all-gather
+    boundary (src/parallel_ops/combine.cc:135)."""
+
+    def __init__(self, layer, input_shapes):
+        self.combine_dim = layer.get_property("dim", 0)
+        self.combine_degree = layer.get_property("degree", 1)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [tuple(self.input_shapes[0])]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def preferred_spec_update(self, entries):
+        d = self.combine_dim % len(self.output_shapes[0])
+        entries = list(entries)
+        entries[d] = None
+        return entries
+
+
+@register_op(OperatorType.REPLICATE)
+class Replicate(ParallelOpBase):
+    """Broadcast to ``replicate_degree`` replicas
+    (src/parallel_ops/replicate.cc). Output constrained fully replicated."""
+
+    def __init__(self, layer, input_shapes):
+        self.replicate_degree = layer.get_property("degree", 1)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [tuple(self.input_shapes[0])]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def preferred_spec_update(self, entries):
+        return [None] * len(entries)
+
+
+@register_op(OperatorType.REDUCTION)
+class Reduction(ParallelOpBase):
+    """Sum ``reduction_degree`` partial replicas laid out along dim
+    ``reduction_dim`` (src/parallel_ops/reduction.cc). The replica dim is
+    explicit here (a real tensor dim, as in parallel_tensor.h:40): input
+    shape (..., k*d, ...) reduces groups of k along that dim."""
+
+    def __init__(self, layer, input_shapes):
+        self.reduction_dim = layer.get_property("dim", 0)
+        self.reduction_degree = layer.get_property("degree", 1)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        shp = list(self.input_shapes[0])
+        d = self.reduction_dim % len(shp)
+        if shp[d] % self.reduction_degree:
+            raise ValueError("reduction: size not divisible by degree")
+        shp[d] //= self.reduction_degree
+        return [tuple(shp)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        d = self.reduction_dim % x.ndim
+        k = self.reduction_degree
+        new_shape = x.shape[:d] + (k, x.shape[d] // k) + x.shape[d + 1:]
+        return [jnp.sum(x.reshape(new_shape), axis=d)]
+
+
+@register_op(OperatorType.FUSED_PARALLEL)
+class FusedParallelOp(ParallelOpBase):
+    """Chain of parallel-op descriptors applied as one boundary
+    (include/flexflow/parallel_ops/fused_parallel_op.h:15). Property
+    ``ops`` is a list of (op_type, dim, degree, axis) tuples."""
+
+    def __init__(self, layer, input_shapes):
+        self.fused_ops = [
+            (OperatorType[k] if isinstance(k, str) else k, d, g, a)
+            for (k, d, g, a) in layer.get_property("ops", [])
+        ]
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        shp = list(self.input_shapes[0])
+        for (kind, dim, degree, _axis) in self.fused_ops:
+            if kind == OperatorType.REDUCTION:
+                d = dim % len(shp)
+                if shp[d] % degree:
+                    raise ValueError("fused reduction: size not divisible")
+                shp[d] //= degree
+        return [tuple(shp)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        for (kind, dim, degree, _axis) in self.fused_ops:
+            if kind == OperatorType.REDUCTION:
+                d = dim % x.ndim
+                new_shape = x.shape[:d] + (degree, x.shape[d] // degree) + x.shape[d + 1:]
+                x = jnp.sum(x.reshape(new_shape), axis=d)
+        return [x]
+
+    def preferred_spec_update(self, entries):
+        entries = list(entries)
+        for (kind, dim, degree, axis) in self.fused_ops:
+            if kind == OperatorType.REPARTITION:
+                entries[dim % len(entries)] = axis
+            elif kind == OperatorType.COMBINE:
+                entries[dim % len(entries)] = None
+            elif kind == OperatorType.REPLICATE:
+                entries = [None] * len(entries)
+        return entries

@@ -1,0 +1,67 @@
+"""PCG sharding round-trips: degree form ↔ PartitionSpec form.
+
+The canonical ``ParallelDim``/``ParallelTensorShape`` classes live in
+``flexflow_tpu.tensor`` (re-exported here): every tensor dimension carries
+a parallel *degree* plus the mesh axes it is sharded on, replica dims model
+weight replication (reference include/flexflow/parallel_tensor.h:36-163).
+Where the reference maps dims onto Legion index-space partitions, we map
+them onto ``jax.sharding.PartitionSpec`` entries over a named ``Mesh`` —
+the degrees ARE the mesh-axis extents, and GSPMD materializes the data
+movement Legion partitions performed.
+
+This module adds the conversions the search/strategy layers need:
+
+* ``shape_from_partition_spec(shape, spec, mesh)`` — spec form → degree
+  form (degrees read off the mesh-axis extents);
+* ``spec_to_degrees`` — shorthand returning just the degree vector;
+* ``replicated_shape`` — an unsharded degree-form shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape
+
+__all__ = [
+    "ParallelDim",
+    "ParallelTensorShape",
+    "MAX_TENSOR_DIM",
+    "replicated_shape",
+    "shape_from_partition_spec",
+    "spec_to_degrees",
+]
+
+MAX_TENSOR_DIM = 8  # reference MAX_TENSOR_DIM (include/flexflow/config.h)
+
+
+def replicated_shape(shape: Sequence[int],
+                     dtype: DataType = DataType.FLOAT) -> ParallelTensorShape:
+    return ParallelTensorShape.make(list(shape), dtype)
+
+
+def shape_from_partition_spec(shape: Sequence[int], spec: Optional[P], mesh,
+                              dtype: DataType = DataType.FLOAT
+                              ) -> ParallelTensorShape:
+    """Spec form → degree form, reading degrees off the mesh-axis extents."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    dims = []
+    for s, a in zip(shape, entries):
+        if a is None:
+            dims.append(ParallelDim(s))
+        else:
+            axes = a if isinstance(a, tuple) else (a,)
+            deg = 1
+            for ax in axes:
+                deg *= axis_sizes[ax]
+            dims.append(ParallelDim(s, deg, tuple(axes)))
+    return ParallelTensorShape(tuple(dims), dtype)
+
+
+def spec_to_degrees(shape: Sequence[int], spec: Optional[P], mesh) -> List[int]:
+    return list(shape_from_partition_spec(shape, spec, mesh).degrees)

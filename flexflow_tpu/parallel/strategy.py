@@ -80,13 +80,67 @@ def tensor_parallel_overrides(nodes, mesh, strategy: Strategy) -> Strategy:
     return strategy
 
 
+# ops that preserve shape and follow their input's sharding: a manual
+# parallel op's layout propagates through these until the next layout- or
+# value-changing op (matches the reference, where a Repartition changes the
+# ParallelTensor layout every consumer then sees)
+_FOLLOW_OPS = frozenset({
+    OperatorType.RELU, OperatorType.GELU, OperatorType.SIGMOID,
+    OperatorType.TANH, OperatorType.ELU, OperatorType.EXP, OperatorType.SIN,
+    OperatorType.COS, OperatorType.POW, OperatorType.RSQRT,
+    OperatorType.IDENTITY, OperatorType.SCALAR_MULTIPLY,
+    OperatorType.SCALAR_ADD, OperatorType.SCALAR_SUB,
+    OperatorType.SCALAR_TRUE_DIV, OperatorType.DROPOUT, OperatorType.CAST,
+    OperatorType.SOFTMAX, OperatorType.LAYERNORM,
+})
+
+
+def _axis_entry_valid(entry, valid_axes) -> bool:
+    if entry is None:
+        return True
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    return all(a in valid_axes for a in axes)
+
+
 def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
+    by_guid = {n.op.guid: n for n in nodes}
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    # guid -> spec entries forced by an upstream manual parallel op
+    forced: Dict[int, List] = {}
     for node in nodes:
         st = strategy.get(node.op.guid)
-        if st is None:
-            continue
-        node.output_specs = list(st.output_specs)
-        node.param_specs = dict(st.param_specs)
+        if st is not None:
+            node.output_specs = list(st.output_specs)
+            node.param_specs = dict(st.param_specs)
+        op = node.op
+        is_par = getattr(op, "is_parallel_op", False)
+        if (is_par and hasattr(op, "preferred_spec_update")) or (
+            op.op_type in _FOLLOW_OPS and node.input_refs
+            and node.input_refs[0][0] == "op"
+            and node.input_refs[0][1] in forced
+        ):
+            ref = node.input_refs[0]
+            nd = len(op.output_shapes[0])
+            if ref[0] == "op" and ref[1] in forced:
+                src = forced[ref[1]]
+            elif ref[0] == "op" and ref[1] in by_guid:
+                src = by_guid[ref[1]].output_specs[ref[2]]
+            else:
+                src = None
+            entries = (list(src) + [None] * nd)[:nd] if src else [None] * nd
+            if is_par:
+                if (op.op_type == OperatorType.REPARTITION
+                        and op.axis in axis_sizes
+                        and op.repartition_degree != axis_sizes[op.axis]):
+                    raise ValueError(
+                        f"repartition degree {op.repartition_degree} != mesh "
+                        f"axis '{op.axis}' size {axis_sizes[op.axis]} — under "
+                        f"GSPMD the degree must equal the axis extent")
+                entries = op.preferred_spec_update(entries)
+            entries = [e if _axis_entry_valid(e, axis_sizes) else None
+                       for e in entries]
+            node.output_specs = [P(*entries)] + node.output_specs[1:]
+            forced[op.guid] = entries
 
 
 def search_strategy(nodes, mesh, machine_spec, config) -> Strategy:

@@ -1,0 +1,94 @@
+"""PCG primitives + parallel (resharding) ops.
+
+Covers SURVEY §2.3: ParallelDim/ParallelTensorShape round-trips and the
+four resharding ops as graph nodes (reference src/parallel_ops/*.cc).
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import LossType, MetricsType
+from flexflow_tpu.machine import make_mesh
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.optimizers import SGDOptimizer
+from flexflow_tpu.parallel.pcg import (ParallelDim, ParallelTensorShape,
+                                       shape_from_partition_spec,
+                                       spec_to_degrees)
+
+
+class TestParallelTensorShape:
+    def test_spec_roundtrip(self):
+        mesh = make_mesh(8, {"data": 4, "model": 2})
+        pts = ParallelTensorShape((
+            ParallelDim(64, 4, ("data",)), ParallelDim(128),
+            ParallelDim(256, 2, ("model",)),
+        ))
+        spec = pts.partition_spec()
+        assert spec == P("data", None, "model")
+        back = shape_from_partition_spec((64, 128, 256), spec, mesh)
+        assert back.degrees == (4, 1, 2)
+        assert back.sizes == (64, 128, 256)
+        assert pts.total_degree == 8
+
+    def test_replica_dim_dropped_from_spec(self):
+        pts = ParallelTensorShape((
+            ParallelDim(4, 4, ("data",), is_replica_dim=True),
+            ParallelDim(32), ParallelDim(64),
+        ))
+        assert pts.partition_spec() == P(None, None)
+        assert pts.sizes == (32, 64)
+        assert pts.num_replica == 4
+
+    def test_invalid_degree(self):
+        with pytest.raises(ValueError):
+            ParallelDim(10, 4, ("data",))
+
+    def test_spec_to_degrees(self):
+        mesh = make_mesh(8, {"data": 4, "model": 2})
+        assert spec_to_degrees((64, 32), P("data"), mesh) == [4, 1]
+        assert spec_to_degrees((64, 32), None, mesh) == [1, 1]
+        assert spec_to_degrees((64, 32), P(("data", "model"),), mesh) == [8, 1]
+
+
+class TestParallelOps:
+    def _train(self, build, n=16, d=8):
+        cfg = FFConfig(batch_size=n, only_data_parallel=True)
+        ff = FFModel(cfg)
+        x_t = ff.create_tensor((n, d))
+        out = build(ff, x_t)
+        ff.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                   [MetricsType.MEAN_SQUARED_ERROR])
+        rs = np.random.RandomState(0)
+        x = rs.randn(n, d).astype(np.float32)
+        y = rs.randn(n, out.shape[-1]).astype(np.float32)
+        ff.fit(x, y, epochs=1, verbose=False)
+        return ff, x
+
+    def test_repartition_combine_replicate_pipeline(self):
+        def build(ff, x):
+            h = ff.dense(x, 32)
+            h = ff.repartition(h, dim=0, degree=8)
+            h = ff.relu(h)
+            h = ff.combine(h, dim=0, degree=8)
+            h = ff.replicate(h, degree=8)
+            return ff.dense(h, 4)
+
+        ff, x = self._train(build)
+        out = ff.predict(x)
+        assert out.shape == (16, 4)
+        assert np.isfinite(out).all()
+
+    def test_reduction_sums_replica_groups(self):
+        def build(ff, x):
+            h = ff.dense(x, 32, name="d1")
+            return ff.reduction(h, dim=1, degree=4)  # 32 -> 8, sums groups
+
+        ff, x = self._train(build)
+        out = ff.predict(x)
+        assert out.shape == (16, 8)
+        k = ff.get_parameter("d1")
+        b = ff.get_parameter("d1", "bias")
+        ref = (x @ k + b).reshape(16, 4, 8).sum(axis=1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
