@@ -223,4 +223,5 @@ class GraphExecutor:
         return self._jit_fwd[training]
 
     def batch_sharding(self):
-        return NamedSharding(self.mesh, P(tuple(self.data_axes)))
+        da = tuple(self.data_axes)
+        return NamedSharding(self.mesh, P(da) if da else P())

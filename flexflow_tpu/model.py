@@ -395,15 +395,24 @@ class FFModel:
         self.metrics = Metrics(loss_type, list(metrics),
                                preds_are_probs=self._final_is_softmax)
 
-        # --- machine + mesh ---
+        # --- machine + mesh + strategy -----------------------------------
+        # Mirrors the GRAPH_OPTIMIZE task boundary (model.cc:2825): the
+        # search owns the mesh factorization (MachineView enumeration
+        # analog); without a search budget we take the data-parallel
+        # default, optionally with tensor-parallel overrides.
         avail = len(jax.devices())
         # num_devices == 0 means "auto: use every visible device"
         n_dev = min(cfg.num_devices, avail) if cfg.num_devices > 0 else avail
         batch0 = self.input_tensors[0].shape[0] if self.input_tensors else 1
         self.machine_spec = machine_spec or detect_machine_spec(n_dev)
-        if mesh is not None:
-            self.mesh = mesh
-        else:
+        self.search_info = None
+
+        import math as _math
+        from flexflow_tpu.parallel.strategy import (
+            data_parallel_strategy, apply_strategy, tensor_parallel_overrides)
+        from flexflow_tpu.search import unity as _unity
+
+        def _heuristic_mesh():
             if cfg.enable_parameter_parallel and not cfg.only_data_parallel:
                 mp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
             else:
@@ -414,20 +423,57 @@ class FFModel:
             axes = {"data": dp}
             if mp > 1:
                 axes["model"] = mp
-            self.mesh = make_mesh(dp * mp, axes)
+            return make_mesh(dp * mp, axes)
 
-        # --- strategy selection ---
-        from flexflow_tpu.parallel.strategy import (
-            data_parallel_strategy, apply_strategy, search_strategy,
-            tensor_parallel_overrides)
-        if cfg.only_data_parallel or cfg.search_budget <= 0:
-            self.strategy = data_parallel_strategy(nodes, self.mesh)
+        def _heuristic_strategy():
+            st = data_parallel_strategy(nodes, self.mesh)
             if cfg.enable_parameter_parallel:
-                self.strategy = tensor_parallel_overrides(
-                    nodes, self.mesh, self.strategy)
-        else:
-            self.strategy = search_strategy(
-                nodes, self.mesh, self.machine_spec, cfg)
+                st = tensor_parallel_overrides(nodes, self.mesh, st)
+            return st
+
+        self.mesh = mesh
+        self.strategy = None
+        if cfg.import_strategy_file:
+            mesh_axes, self.strategy = _unity.import_strategy_file(
+                cfg.import_strategy_file, nodes)
+            if self.mesh is None:
+                need = _math.prod(mesh_axes.values())
+                if need > avail:
+                    raise ValueError(
+                        f"strategy file {cfg.import_strategy_file} needs a "
+                        f"{mesh_axes} mesh ({need} devices) but only {avail} "
+                        f"are visible")
+                self.mesh = make_mesh(need, mesh_axes)
+            # drop spec axes the actual mesh doesn't carry (file may come
+            # from a differently-shaped machine)
+            valid = set(self.mesh.axis_names)
+            for st in self.strategy.values():
+                st.output_specs = [
+                    (P(*(e if e in valid else None for e in s))
+                     if s is not None else None)
+                    for s in st.output_specs
+                ]
+                st.param_specs = {
+                    k: P(*(e if e in valid else None for e in v))
+                    for k, v in st.param_specs.items()
+                }
+        elif (cfg.search_budget > 0 and not cfg.only_data_parallel
+              and mesh is None):
+            try:
+                mesh_axes, self.strategy, self.search_info = _unity.graph_optimize(
+                    nodes, self.machine_spec, cfg, n_dev, batch=batch0)
+                self.mesh = make_mesh(_math.prod(mesh_axes.values()), mesh_axes)
+            except (RuntimeError, ImportError, OSError) as e:
+                print(f"[flexflow_tpu] search unavailable ({e}); "
+                      f"falling back to data-parallel")
+        if self.mesh is None:
+            self.mesh = _heuristic_mesh()
+        if self.strategy is None:
+            self.strategy = _heuristic_strategy()
+        if cfg.export_strategy_file:
+            axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            _unity.export_strategy_file(cfg.export_strategy_file, axes_now,
+                                        self.strategy, nodes)
         apply_strategy(nodes, self.strategy, self.mesh)
 
         compute_dtype = (
@@ -439,7 +485,7 @@ class FFModel:
         self.executor = GraphExecutor(
             nodes, input_names, final_node.op.guid, self.mesh, loss_type,
             self.metrics, self.optimizer, compute_dtype=compute_dtype,
-            data_axes=data_axes or ("data",),
+            data_axes=data_axes,  # may be empty: batch replicated
             final_is_softmax=self._final_is_softmax,
         )
         self._rng, sub = jax.random.split(self._rng)
@@ -449,7 +495,8 @@ class FFModel:
 
     # ======================= data staging ==================================
     def _shard_batch(self, arr: np.ndarray) -> jax.Array:
-        sharding = NamedSharding(self.mesh, P(self.executor.data_axes))
+        da = self.executor.data_axes
+        sharding = NamedSharding(self.mesh, P(da) if da else P())
         return jax.device_put(jnp.asarray(arr), sharding)
 
     def _stage_inputs(self, xs) -> Dict[str, jax.Array]:

@@ -143,11 +143,3 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
             forced[op.guid] = entries
 
 
-def search_strategy(nodes, mesh, machine_spec, config) -> Strategy:
-    """Unity-style automatic strategy search. Falls back to DP until the
-    search stack (flexflow_tpu/search) decides otherwise."""
-    try:
-        from flexflow_tpu.search.unity import graph_optimize
-        return graph_optimize(nodes, mesh, machine_spec, config)
-    except ImportError:
-        return data_parallel_strategy(nodes, mesh)

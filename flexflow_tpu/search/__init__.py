@@ -1,0 +1,15 @@
+"""Auto-parallelization search stack (SURVEY §2.5 — the crown jewels).
+
+Native C++ core (native/ffs_search.cpp, loaded via ctypes) implementing the
+reference's search algorithms re-targeted at TPU/GSPMD:
+
+* frontier DP with memoized sharding states (find_optimal_*_graph_time)
+* alpha pruning + budget-scaled beam (base_optimize best-first queue)
+* memory-aware lambda binary search (graph_optimize_with_memory)
+* MCMC simulated-annealing refinement (FFModel::mcmc_optimize)
+* taskgraph simulator with compute/ICI stream overlap (Simulator)
+* analytic TPU machine model (Simple/Enhanced/NetworkedMachineModel)
+
+`flexflow_tpu.search.unity.graph_optimize` is the entry point used by
+FFModel.compile when `search_budget > 0`.
+"""

@@ -1,0 +1,84 @@
+"""ctypes loader for the native ffsearch library.
+
+Analog of the reference's in-process C++ search invoked through a Legion
+task boundary (GRAPH_OPTIMIZE_TASK_ID, src/runtime/model.cc:2825): here the
+boundary is a JSON string through a C ABI. The library is built from
+native/ by `make`; if the .so is missing we attempt a one-shot build with
+the system compiler (g++ is part of the supported toolchain).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Any, Dict, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libffsearch.so")
+
+_lib = None
+_load_error: Optional[str] = None
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                           timeout=120)
+        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Load (building if necessary) the native library; None if unavailable."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if _load_error is not None:
+        return None
+    if not os.path.exists(_LIB_PATH) and not _build():
+        _load_error = "libffsearch.so missing and build failed"
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ffs_optimize.argtypes = [ctypes.c_char_p]
+        lib.ffs_optimize.restype = ctypes.c_void_p
+        lib.ffs_simulate.argtypes = [ctypes.c_char_p]
+        lib.ffs_simulate.restype = ctypes.c_void_p
+        lib.ffs_free.argtypes = [ctypes.c_void_p]
+        lib.ffs_version.restype = ctypes.c_char_p
+        _lib = lib
+        return _lib
+    except OSError as e:  # pragma: no cover
+        _load_error = str(e)
+        return None
+
+
+def _call(fn_name: str, request: Dict[str, Any]) -> Dict[str, Any]:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(f"ffsearch native library unavailable: {_load_error}")
+    fn = getattr(lib, fn_name)
+    ptr = fn(json.dumps(request).encode())
+    try:
+        out = json.loads(ctypes.string_at(ptr).decode())
+    finally:
+        lib.ffs_free(ptr)
+    if "error" in out:
+        raise RuntimeError(f"ffsearch: {out['error']}")
+    return out
+
+
+def native_optimize(request: Dict[str, Any]) -> Dict[str, Any]:
+    return _call("ffs_optimize", request)
+
+
+def native_simulate(request: Dict[str, Any]) -> Dict[str, Any]:
+    return _call("ffs_simulate", request)
+
+
+def available() -> bool:
+    return get_lib() is not None

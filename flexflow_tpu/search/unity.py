@@ -1,0 +1,204 @@
+"""Unity-style graph optimization: op graph → (mesh shape, per-op shardings).
+
+The Python half of the search stack: serialize the materialized op graph
+(analog of the PCG handed to Graph::graph_optimize_task,
+src/runtime/graph.cc:2047) to the native core, decode the returned strategy
+into PartitionSpecs, and provide strategy file export/import
+(--export-strategy / --import-strategy, reference config.h:141-142).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import DimRole
+from flexflow_tpu.parallel.strategy import OpStrategy, Strategy
+
+
+def _param_shapes(op) -> Dict[str, List[int]]:
+    """Parameter name → shape, without materializing arrays."""
+    try:
+        tree = jax.eval_shape(op.init_params, jax.random.PRNGKey(0))
+    except Exception:
+        return {}
+    return {k: list(v.shape) for k, v in tree.items()}
+
+
+def _node_attrs(op) -> Dict[str, Any]:
+    attrs = {}
+    for k in ("num_heads", "groups", "axis", "out_dim", "k", "n"):
+        v = getattr(op, k, None)
+        if isinstance(v, (int, float)):
+            attrs[k] = v
+    return attrs
+
+
+def serialize_graph(nodes) -> List[Dict[str, Any]]:
+    out = []
+    for node in nodes:
+        op = node.op
+        inputs = []
+        for ref in node.input_refs:
+            if ref[0] == "op":
+                inputs.append([ref[1], ref[2]])
+            else:  # graph input staged from host — source guid -1
+                inputs.append([-1, 0])
+        roles = [[r.value for r in rr] for rr in op.output_dim_roles()]
+        out.append(dict(
+            guid=op.guid,
+            type=op.op_type.name,
+            name=op.name,
+            inputs=inputs,
+            input_shapes=[list(s) for s in op.input_shapes],
+            output_shapes=[list(s) for s in op.output_shapes],
+            roles=roles,
+            params=_param_shapes(op),
+            flops=float(op.flops()),
+            dtype_size=op.dtype.size,
+            attrs=_node_attrs(op),
+        ))
+    return out
+
+
+def machine_to_json(spec, num_devices: int) -> Dict[str, Any]:
+    return dict(
+        num_devices=num_devices,
+        flops=spec.flops,
+        hbm_bw=spec.hbm_bw,
+        hbm_cap=spec.hbm_cap,
+        ici_bw=spec.ici_bw,
+        ici_latency=spec.ici_latency,
+        dcn_bw=spec.dcn_bw,
+        dcn_latency=spec.dcn_latency,
+        num_slices=spec.num_slices,
+    )
+
+
+def _entries_to_spec(entries: List[Optional[str]]) -> P:
+    while entries and entries[-1] is None:
+        entries = entries[:-1]
+    return P(*entries)
+
+
+def decode_strategy(resp: Dict[str, Any], nodes) -> Tuple[Dict[str, int], Strategy]:
+    mesh_axes = {k: int(v) for k, v in resp["mesh"].items() if int(v) > 1}
+    if not mesh_axes:
+        mesh_axes = {"data": 1}
+    valid = set(mesh_axes)
+    strategy: Strategy = {}
+    for node in nodes:
+        oj = resp["ops"].get(str(node.op.guid))
+        if oj is None:
+            continue
+        outs = []
+        for entries in oj["outputs"]:
+            entries = [e if e in valid else None for e in entries]
+            outs.append(_entries_to_spec(entries))
+        params = {}
+        for pname, entries in oj.get("params", {}).items():
+            entries = [e if e in valid else None for e in entries]
+            params[pname] = _entries_to_spec(entries)
+        st = OpStrategy(output_specs=outs, param_specs=params)
+        st.choice = oj.get("choice")
+        strategy[node.op.guid] = st
+    return mesh_axes, strategy
+
+
+def graph_optimize(nodes, machine_spec, config, num_devices: int,
+                   measured: Optional[Dict[str, float]] = None,
+                   batch: int = 0,
+                   ) -> Tuple[Dict[str, int], Strategy, Dict[str, Any]]:
+    """Run the native Unity search. Returns (mesh_axes, strategy, info).
+
+    Raises RuntimeError/ImportError when the native core is unavailable —
+    callers fall back to the data-parallel default, matching the
+    reference's --only-data-parallel escape hatch.
+    """
+    from flexflow_tpu.search.native import native_optimize
+
+    rules = []
+    if config.substitution_json:
+        # an explicitly-requested rules file must fail loudly (ValueError is
+        # not in compile()'s fallback set, so a bad path/contents aborts
+        # instead of silently degrading to data-parallel)
+        try:
+            with open(config.substitution_json) as f:
+                rules = json.load(f).get("rules", [])
+        except OSError as e:
+            raise ValueError(
+                f"--substitution-json {config.substitution_json}: {e}") from e
+    threshold = 0
+    if config.memory_search and config.memory_threshold_mb:
+        threshold = config.memory_threshold_mb * (1 << 20)
+    elif config.memory_search:
+        threshold = config.memory_per_chip_mb * (1 << 20)
+    request = dict(
+        nodes=serialize_graph(nodes),
+        machine=machine_to_json(machine_spec, num_devices),
+        config=dict(
+            budget=config.search_budget,
+            alpha=config.search_alpha,
+            only_data_parallel=config.only_data_parallel,
+            enable_parameter_parallel=config.enable_parameter_parallel
+                or config.enable_attribute_parallel,
+            overlap=config.search_overlap_backward_update,
+            training=True,
+            memory_threshold=threshold,
+            seed=config.seed,
+            batch=batch,
+            rules=rules,
+        ),
+        measured=measured or {},
+    )
+    resp = native_optimize(request)
+    mesh_axes, strategy = decode_strategy(resp, nodes)
+    info = dict(predicted_time=resp.get("predicted_time"),
+                predicted_memory=resp.get("predicted_memory"),
+                stats=resp.get("stats", {}))
+    return mesh_axes, strategy, info
+
+
+# ---- strategy files (--export-strategy / --import-strategy) ---------------
+
+def export_strategy_file(path: str, mesh_axes: Dict[str, int],
+                         strategy: Strategy, nodes) -> None:
+    """Serialize a strategy keyed by op *name* (stable across runs, unlike
+    guids — the reference keys by FFConfig::get_hash_id, strategy.cc:26)."""
+    by_guid = {n.op.guid: n.op.name for n in nodes}
+    ops = {}
+    for guid, st in strategy.items():
+        name = by_guid.get(guid)
+        if name is None:
+            continue
+        ops[name] = dict(
+            choice=getattr(st, "choice", None),
+            outputs=[list(s) if s is not None else None for s in st.output_specs],
+            params={k: list(v) for k, v in st.param_specs.items()},
+        )
+    with open(path, "w") as f:
+        json.dump(dict(version=1, mesh=mesh_axes, ops=ops), f, indent=1)
+
+
+def import_strategy_file(path: str, nodes) -> Tuple[Dict[str, int], Strategy]:
+    with open(path) as f:
+        data = json.load(f)
+    mesh_axes = {k: int(v) for k, v in data["mesh"].items()}
+    strategy: Strategy = {}
+    for node in nodes:
+        oj = data["ops"].get(node.op.name)
+        if oj is None:
+            continue
+        outs = [
+            (P(*e) if e is not None else None)
+            for e in oj["outputs"]
+        ]
+        params = {k: P(*v) for k, v in oj.get("params", {}).items()}
+        st = OpStrategy(output_specs=outs, param_specs=params)
+        st.choice = oj.get("choice")
+        strategy[node.op.guid] = st
+    return mesh_axes, strategy

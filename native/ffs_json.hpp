@@ -1,0 +1,309 @@
+// Minimal JSON value + parser + writer for the ffsearch core.
+//
+// The reference vendors nlohmann/json (deps/json) for substitution-rule
+// loading (src/runtime/substitution_loader.cc); this is a self-contained
+// ~300-line replacement covering the subset ffsearch needs: objects,
+// arrays, strings (with escapes), doubles, bools, null. Numbers are held
+// as double (graph sizes / byte counts fit in 53 bits).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ffsearch {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(double d) : type_(Type::Number), num_(d) {}
+  Json(int i) : type_(Type::Number), num_(i) {}
+  Json(int64_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Json(size_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json array() { return Json(JsonArray{}); }
+  static Json object() { return Json(JsonObject{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(std::llround(num_)) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& items() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const JsonObject& fields() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+  JsonArray& items_mut() { return arr_; }
+  JsonObject& fields_mut() { return obj_; }
+
+  // object access: get(key) returns Null json when missing
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+  void set(const std::string& key, Json v) {
+    type_ = Type::Object;
+    obj_[key] = std::move(v);
+  }
+  void push_back(Json v) {
+    type_ = Type::Array;
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Array) return arr_.size();
+    if (type_ == Type::Object) return obj_.size();
+    return 0;
+  }
+  const Json& operator[](size_t i) const { return arr_.at(i); }
+
+  // ---- parse ----
+  static Json parse(const std::string& text) {
+    Parser p(text);
+    Json v = p.parse_value();
+    p.skip_ws();
+    if (!p.at_end()) throw std::runtime_error("json: trailing characters");
+    return v;
+  }
+
+  // ---- serialize ----
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+ private:
+  struct Parser {
+    const std::string& s;
+    size_t i = 0;
+    explicit Parser(const std::string& text) : s(text) {}
+    bool at_end() const { return i >= s.size(); }
+    void skip_ws() {
+      while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r'))
+        ++i;
+    }
+    char peek() {
+      if (at_end()) throw std::runtime_error("json: unexpected end");
+      return s[i];
+    }
+    char next() {
+      char c = peek();
+      ++i;
+      return c;
+    }
+    void expect(char c) {
+      if (next() != c) throw std::runtime_error(std::string("json: expected ") + c);
+    }
+    Json parse_value() {
+      skip_ws();
+      char c = peek();
+      if (c == '{') return parse_object();
+      if (c == '[') return parse_array();
+      if (c == '"') return Json(parse_string());
+      if (c == 't') { literal("true"); return Json(true); }
+      if (c == 'f') { literal("false"); return Json(false); }
+      if (c == 'n') { literal("null"); return Json(); }
+      return parse_number();
+    }
+    void literal(const char* lit) {
+      for (const char* p = lit; *p; ++p) expect(*p);
+    }
+    Json parse_object() {
+      expect('{');
+      JsonObject obj;
+      skip_ws();
+      if (peek() == '}') { ++i; return Json(std::move(obj)); }
+      while (true) {
+        skip_ws();
+        std::string key = parse_string();
+        skip_ws();
+        expect(':');
+        obj[key] = parse_value();
+        skip_ws();
+        char c = next();
+        if (c == '}') break;
+        if (c != ',') throw std::runtime_error("json: expected , or }");
+      }
+      return Json(std::move(obj));
+    }
+    Json parse_array() {
+      expect('[');
+      JsonArray arr;
+      skip_ws();
+      if (peek() == ']') { ++i; return Json(std::move(arr)); }
+      while (true) {
+        arr.push_back(parse_value());
+        skip_ws();
+        char c = next();
+        if (c == ']') break;
+        if (c != ',') throw std::runtime_error("json: expected , or ]");
+      }
+      return Json(std::move(arr));
+    }
+    std::string parse_string() {
+      expect('"');
+      std::string out;
+      while (true) {
+        char c = next();
+        if (c == '"') break;
+        if (c == '\\') {
+          char e = next();
+          switch (e) {
+            case 'n': out += '\n'; break;
+            case 't': out += '\t'; break;
+            case 'r': out += '\r'; break;
+            case 'b': out += '\b'; break;
+            case 'f': out += '\f'; break;
+            case '/': out += '/'; break;
+            case '\\': out += '\\'; break;
+            case '"': out += '"'; break;
+            case 'u': {  // \uXXXX — keep BMP only, encode UTF-8
+              unsigned cp = 0;
+              for (int k = 0; k < 4; ++k) {
+                char h = next();
+                cp <<= 4;
+                if (h >= '0' && h <= '9') cp |= h - '0';
+                else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                else throw std::runtime_error("json: bad \\u escape");
+              }
+              if (cp < 0x80) out += static_cast<char>(cp);
+              else if (cp < 0x800) {
+                out += static_cast<char>(0xC0 | (cp >> 6));
+                out += static_cast<char>(0x80 | (cp & 0x3F));
+              } else {
+                out += static_cast<char>(0xE0 | (cp >> 12));
+                out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+                out += static_cast<char>(0x80 | (cp & 0x3F));
+              }
+              break;
+            }
+            default: throw std::runtime_error("json: bad escape");
+          }
+        } else {
+          out += c;
+        }
+      }
+      return out;
+    }
+    Json parse_number() {
+      size_t start = i;
+      if (peek() == '-') ++i;
+      while (!at_end() && (isdigit(s[i]) || s[i] == '.' || s[i] == 'e' ||
+                           s[i] == 'E' || s[i] == '+' || s[i] == '-'))
+        ++i;
+      return Json(std::stod(s.substr(start, i - start)));
+    }
+  };
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.0e15) {
+          os << static_cast<int64_t>(num_);
+        } else {
+          os.precision(17);
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t k = 0; k < arr_.size(); ++k) {
+          if (k) os << ',';
+          arr_[k].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, kv.first);
+          os << ':';
+          kv.second.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\t': os << "\\t"; break;
+        case '\r': os << "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0.0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace ffsearch

@@ -1,0 +1,98 @@
+// TPU machine model: analytic compute + collective cost functions.
+//
+// Replaces the reference's SimpleMachineModel / EnhancedMachineModel /
+// NetworkedMachineModel hierarchy (include/flexflow/simulator.h:212-515)
+// with the model that matches TPU hardware: per-chip peak FLOP/s and HBM
+// bandwidth set the roofline for compute; the ICI torus sets ring-collective
+// costs inside a slice; DCN connects slices. The reference's
+// per-(op,machine-view) measured-cost cache (simulator.h:750) maps to the
+// `measured` override table injected from Python profiles.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <string>
+
+#include "ffs_json.hpp"
+
+namespace ffsearch {
+
+struct MachineModel {
+  int num_devices = 1;
+  double flops = 197e12;       // bf16 peak FLOP/s per chip
+  double hbm_bw = 0.82e12;     // bytes/s
+  double hbm_cap = 16e9;       // bytes
+  double ici_bw = 45e9;        // bytes/s per link direction
+  double ici_latency = 1e-6;   // seconds per hop
+  double dcn_bw = 25e9;        // bytes/s per slice pair
+  double dcn_latency = 10e-6;
+  int num_slices = 1;
+  double mxu_efficiency = 0.55;  // achievable fraction of peak on real shapes
+  double min_op_time = 5e-7;     // floor per fused op (dispatch overhead)
+
+  static MachineModel from_json(const Json& j) {
+    MachineModel m;
+    m.num_devices = static_cast<int>(j.get("num_devices").as_int(1));
+    m.flops = j.get("flops").as_double(m.flops);
+    m.hbm_bw = j.get("hbm_bw").as_double(m.hbm_bw);
+    m.hbm_cap = j.get("hbm_cap").as_double(m.hbm_cap);
+    m.ici_bw = j.get("ici_bw").as_double(m.ici_bw);
+    m.ici_latency = j.get("ici_latency").as_double(m.ici_latency);
+    m.dcn_bw = j.get("dcn_bw").as_double(m.dcn_bw);
+    m.dcn_latency = j.get("dcn_latency").as_double(m.dcn_latency);
+    m.num_slices = static_cast<int>(j.get("num_slices").as_int(1));
+    m.mxu_efficiency = j.get("mxu_efficiency").as_double(m.mxu_efficiency);
+    return m;
+  }
+
+  // Effective bidirectional ring bandwidth per chip.
+  double ring_bw() const { return ici_bw * 2.0; }
+
+  // Ring all-reduce of `bytes` over `k` chips: 2(k-1)/k * B / bw.
+  double allreduce_time(double bytes, int k) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    return ici_latency * (k - 1) + 2.0 * (k - 1) / k * bytes / ring_bw();
+  }
+
+  // All-gather producing `bytes` full output on each of `k` chips.
+  double allgather_time(double bytes, int k) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
+  }
+
+  // Reduce-scatter of `bytes` over `k` chips.
+  double reducescatter_time(double bytes, int k) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
+  }
+
+  // All-to-all: each chip exchanges its (bytes/k) shard with k-1 peers.
+  double alltoall_time(double bytes, int k) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    return ici_latency + bytes * (k - 1) / k / k / ring_bw();
+  }
+
+  // Cross-slice (DCN) all-reduce of `bytes` across num_slices.
+  double dcn_allreduce_time(double bytes) const {
+    if (num_slices <= 1 || bytes <= 0) return 0.0;
+    return dcn_latency * (num_slices - 1) +
+           2.0 * (num_slices - 1) / num_slices * bytes / dcn_bw;
+  }
+
+  // Roofline: time for `flop` FLOPs touching `bytes` of HBM on one chip.
+  // `dtype_size` > 2 (f32) halves MXU throughput.
+  double compute_time(double flop, double bytes, int dtype_size = 2) const {
+    double peak = flops * mxu_efficiency * (dtype_size <= 2 ? 1.0 : 0.5);
+    double t = std::max(flop / peak, bytes / hbm_bw);
+    return std::max(t, min_op_time);
+  }
+};
+
+// Measured-cost override table: key = "<guid>:<choice>" or param-hash from
+// Python-side profiling, value = seconds. Analog of the reference's
+// hash_to_op_cost cache fed by real microbenchmarks (simulator.h:750-752).
+using MeasuredCosts = std::map<std::string, double>;
+
+}  // namespace ffsearch

@@ -1,0 +1,515 @@
+// Unity-style auto-parallelization search — native core.
+//
+// Re-implements the algorithms of the reference's search stack for the
+// TPU/GSPMD target (SURVEY §2.5):
+//
+//  * frontier DP with memoized sharding states  ≙ SearchHelper's
+//    find_optimal_{sequence,nonsequence}_graph_time (graph.h:170): at the
+//    graph's bottleneck (post-dominator) nodes the live-tensor frontier
+//    collapses to one spec, which is exactly where the reference memoizes
+//    sequence splits; between bottlenecks the beam bounds the state set.
+//  * alpha pruning + budget-scaled beam          ≙ base_optimize's
+//    best-first queue with `cur > best*alpha` discard (substitution.cc:2229).
+//  * memory-aware lambda binary search           ≙ try_one_lambda /
+//    graph_optimize_with_memory (graph.cc:1883, substitution.cc:1960).
+//  * MCMC simulated annealing refinement         ≙ FFModel::mcmc_optimize
+//    (model.h:795): random re-choice proposals evaluated by the taskgraph
+//    simulator, accepted with exp(-alpha*delta).
+//  * outer mesh-shape enumeration                ≙ MachineView enumeration
+//    (get_valid_machine_views): on TPU the view space is the set of
+//    (data, model) mesh factorizations of the chip count.
+//
+// Input / output: JSON (see flexflow_tpu/search/unity.py for the schema).
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <map>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "ffs_graph.hpp"
+#include "ffs_json.hpp"
+#include "ffs_machine.hpp"
+#include "ffs_sim.hpp"
+#include "ffs_strategy.hpp"
+
+namespace ffsearch {
+namespace {
+
+struct SearchConfig {
+  int budget = 0;
+  double alpha = 0.05;
+  bool only_data_parallel = false;
+  bool enable_parameter_parallel = true;
+  bool overlap = true;
+  bool training = true;
+  double memory_threshold = 0;  // bytes; 0 = machine hbm_cap
+  double opt_state_factor = 2.0;
+  int beam = 0;  // 0 = auto from budget
+  unsigned seed = 0;
+  int64_t batch = 0;  // global batch size; dp must divide it (0 = unconstrained)
+  std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
+
+  static SearchConfig from_json(const Json& j) {
+    SearchConfig c;
+    c.budget = (int)j.get("budget").as_int(0);
+    c.alpha = j.get("alpha").as_double(0.05);
+    c.only_data_parallel = j.get("only_data_parallel").as_bool(false);
+    c.enable_parameter_parallel = j.get("enable_parameter_parallel").as_bool(true);
+    c.overlap = j.get("overlap").as_bool(true);
+    c.training = j.get("training").as_bool(true);
+    c.memory_threshold = j.get("memory_threshold").as_double(0);
+    c.opt_state_factor = j.get("opt_state_factor").as_double(2.0);
+    c.beam = (int)j.get("beam").as_int(0);
+    c.seed = (unsigned)j.get("seed").as_int(0);
+    c.batch = j.get("batch").as_int(0);
+    for (const Json& r : j.get("rules").items()) {
+      std::vector<std::string> names;
+      for (const Json& a : r.get("allow").items()) names.push_back(a.as_string());
+      c.allowed[r.get("op_type").as_string()] = names;
+    }
+    return c;
+  }
+};
+
+using Assignment = std::vector<int>;  // choice index per node
+
+struct DPResult {
+  Assignment assign;
+  double cost = 1e30;
+  double memory = 0;
+  int64_t states = 0;
+  bool ok = false;
+};
+
+// All sharding choices per node, pre-filtered by substitution rules.
+std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& mesh,
+                                             const SearchConfig& cfg) {
+  std::vector<std::vector<Choice>> out;
+  for (const Node& n : g.nodes) {
+    auto cs = enumerate_choices(n, mesh, cfg.enable_parameter_parallel &&
+                                             !cfg.only_data_parallel);
+    auto it = cfg.allowed.find(n.type);
+    if (it != cfg.allowed.end()) {
+      std::vector<Choice> kept;
+      for (auto& c : cs)
+        if (std::find(it->second.begin(), it->second.end(), c.name) !=
+            it->second.end())
+          kept.push_back(std::move(c));
+      if (!kept.empty()) cs = std::move(kept);
+    }
+    out.push_back(std::move(cs));
+  }
+  return out;
+}
+
+// ---- frontier DP ----------------------------------------------------------
+
+struct DPState {
+  // spec per live tensor, in live-list order
+  std::vector<Spec> frontier;
+  double cost = 0;
+  double memory = 0;
+  Assignment assign;
+
+  std::string key() const {
+    std::string k;
+    k.reserve(frontier.size() * 4);
+    for (const Spec& s : frontier) {
+      for (int8_t e : s) k += static_cast<char>(e + 2);
+      k += '|';
+    }
+    return k;
+  }
+};
+
+DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& choices,
+                     const MeshShape& mesh, const MachineModel& m,
+                     const SearchConfig& cfg, double lambda) {
+  const size_t N = g.nodes.size();
+  // remaining-use counts per (guid, out_idx)
+  std::map<std::pair<int64_t, int>, int> uses;
+  for (const Node& n : g.nodes)
+    for (const EdgeRef& e : n.inputs)
+      if (e.src_guid >= 0) uses[{e.src_guid, e.src_idx}]++;
+
+  int beam = cfg.beam > 0 ? cfg.beam
+                          : std::min(2048, std::max(128, 32 * std::max(1, cfg.budget)));
+  double reshard_factor = cfg.training ? 2.0 : 1.0;
+
+  // live tensor list maintained in parallel across all states
+  std::vector<std::pair<int64_t, int>> live;
+  std::vector<DPState> states(1);
+  DPResult res;
+
+  for (size_t i = 0; i < N; ++i) {
+    const Node& n = g.nodes[i];
+    // positions of this node's inputs in the live list
+    std::vector<int> in_pos(n.inputs.size(), -1);
+    for (size_t slot = 0; slot < n.inputs.size(); ++slot) {
+      const EdgeRef& e = n.inputs[slot];
+      if (e.src_guid < 0) continue;
+      for (size_t p = 0; p < live.size(); ++p)
+        if (live[p].first == e.src_guid && live[p].second == e.src_idx) {
+          in_pos[slot] = static_cast<int>(p);
+          break;
+        }
+    }
+    // next live list: drop fully-consumed, append new outputs w/ consumers
+    std::vector<std::pair<int64_t, int>> next_live;
+    std::vector<int> keep_pos;
+    std::map<std::pair<int64_t, int>, int> uses_after = uses;
+    for (const EdgeRef& e : n.inputs)
+      if (e.src_guid >= 0) uses_after[{e.src_guid, e.src_idx}]--;
+    for (size_t p = 0; p < live.size(); ++p)
+      if (uses_after[live[p]] > 0) {
+        keep_pos.push_back(static_cast<int>(p));
+        next_live.push_back(live[p]);
+      }
+    std::vector<int> new_out;
+    for (size_t oi = 0; oi < n.output_shapes.size(); ++oi)
+      if (uses.count({n.guid, (int)oi}) && uses[{n.guid, (int)oi}] > 0) {
+        new_out.push_back(static_cast<int>(oi));
+        next_live.push_back({n.guid, (int)oi});
+      }
+    uses = std::move(uses_after);
+
+    std::map<std::string, DPState> next;
+    double best_cost = 1e30;
+    for (const DPState& st : states) {
+      for (size_t ci = 0; ci < choices[i].size(); ++ci) {
+        const Choice& c = choices[i][ci];
+        double cost = st.cost;
+        // input reshard costs
+        for (size_t slot = 0; slot < n.inputs.size(); ++slot) {
+          if (in_pos[slot] < 0) continue;
+          const Spec& prod = st.frontier[in_pos[slot]];
+          const Spec& need = slot < c.in.size() ? c.in[slot] : prod;
+          int pi = g.index_of.at(n.inputs[slot].src_guid);
+          cost += reshard_factor *
+                  reshard_cost(prod, need,
+                               (double)g.nodes[pi].output_bytes(n.inputs[slot].src_idx),
+                               mesh, m);
+        }
+        NodeCost nc = node_cost(n, c, mesh, m, cfg.training);
+        cost += nc.total();
+        double mem = node_memory(n, c, mesh, cfg.opt_state_factor);
+        cost += lambda * mem;
+        DPState ns;
+        ns.cost = cost;
+        ns.memory = st.memory + mem;
+        ns.assign = st.assign;
+        ns.assign.push_back(static_cast<int>(ci));
+        ns.frontier.reserve(next_live.size());
+        for (int p : keep_pos) ns.frontier.push_back(st.frontier[p]);
+        for (int oi : new_out) ns.frontier.push_back(c.out[oi]);
+        std::string key = ns.key();
+        auto it = next.find(key);
+        if (it == next.end() || it->second.cost > ns.cost)
+          next[key] = std::move(ns);
+        best_cost = std::min(best_cost, cost);
+        res.states++;
+      }
+    }
+    // alpha prune + beam prune
+    std::vector<DPState> pruned;
+    pruned.reserve(next.size());
+    double alpha_cut = best_cost * (1.0 + std::max(0.0, cfg.alpha)) + 1e-12;
+    for (auto& kv : next)
+      if (kv.second.cost <= alpha_cut || next.size() <= 4)
+        pruned.push_back(std::move(kv.second));
+    if ((int)pruned.size() > beam) {
+      std::nth_element(pruned.begin(), pruned.begin() + beam, pruned.end(),
+                       [](const DPState& a, const DPState& b) {
+                         return a.cost < b.cost;
+                       });
+      pruned.resize(beam);
+    }
+    states = std::move(pruned);
+    live = std::move(next_live);
+    if (states.empty()) return res;  // no feasible assignment
+  }
+
+  auto best = std::min_element(states.begin(), states.end(),
+                               [](const DPState& a, const DPState& b) {
+                                 return a.cost < b.cost;
+                               });
+  res.assign = best->assign;
+  res.cost = best->cost;
+  res.memory = best->memory;
+  res.ok = true;
+  return res;
+}
+
+// Memory-aware lambda binary search (graph.cc:1883 try_one_lambda loop).
+DPResult dp_with_memory(const Graph& g, const std::vector<std::vector<Choice>>& choices,
+                        const MeshShape& mesh, const MachineModel& m,
+                        const SearchConfig& cfg, double threshold) {
+  DPResult r0 = frontier_dp(g, choices, mesh, m, cfg, 0.0);
+  if (!r0.ok || threshold <= 0 || r0.memory <= threshold) return r0;
+  // find a lambda that fits: double until feasible, then 10-iter bisect
+  double lo = 0.0, hi = r0.cost / std::max(1.0, r0.memory);
+  DPResult fit;
+  for (int it = 0; it < 20; ++it) {
+    fit = frontier_dp(g, choices, mesh, m, cfg, hi);
+    r0.states += fit.states;
+    if (fit.ok && fit.memory <= threshold) break;
+    lo = hi;
+    hi *= 4.0;
+  }
+  if (!(fit.ok && fit.memory <= threshold)) { r0.ok = false; return r0; }
+  for (int it = 0; it < 10; ++it) {
+    double mid = 0.5 * (lo + hi);
+    DPResult rm = frontier_dp(g, choices, mesh, m, cfg, mid);
+    r0.states += rm.states;
+    if (rm.ok && rm.memory <= threshold) {
+      hi = mid;
+      fit = std::move(rm);
+    } else {
+      lo = mid;
+    }
+  }
+  fit.states = r0.states;
+  return fit;
+}
+
+// ---- MCMC refinement (FFModel::mcmc_optimize, model.cc:3174) -------------
+
+struct MCMCStats {
+  int iters = 0, accepted = 0;
+};
+
+Assignment mcmc_refine(const Graph& g, const std::vector<std::vector<Choice>>& choices,
+                       const MeshShape& mesh, const MachineModel& m,
+                       const SearchConfig& cfg, const TaskgraphSimulator& sim,
+                       Assignment start, double threshold, MCMCStats* stats) {
+  std::mt19937 rng(cfg.seed ? cfg.seed : 0x5eed);
+  auto materialize = [&](const Assignment& a) {
+    std::vector<Choice> cs;
+    cs.reserve(a.size());
+    for (size_t i = 0; i < a.size(); ++i) cs.push_back(choices[i][a[i]]);
+    return cs;
+  };
+  auto eval = [&](const Assignment& a) {
+    SimResult r = sim.simulate(materialize(a));
+    double penalty = threshold > 0 && r.memory > threshold
+                         ? (r.memory - threshold) * 1e-7
+                         : 0.0;
+    return r.iteration_time + penalty;
+  };
+  Assignment cur = start, best = start;
+  double cur_cost = eval(cur), best_cost = cur_cost;
+  int iters = std::max(0, cfg.budget) * 25;
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  for (int it = 0; it < iters; ++it) {
+    size_t node = rng() % g.nodes.size();
+    if (choices[node].size() <= 1) continue;
+    Assignment prop = cur;
+    prop[node] = static_cast<int>(rng() % choices[node].size());
+    if (prop[node] == cur[node]) continue;
+    double c = eval(prop);
+    stats->iters++;
+    // simulated annealing acceptance: exp(-alpha * delta / temperature)
+    double temp = 1.0 - static_cast<double>(it) / std::max(1, iters);
+    double delta = (c - cur_cost) / std::max(1e-9, cur_cost);
+    if (c < cur_cost || unif(rng) < std::exp(-delta / std::max(1e-3, 0.5 * temp))) {
+      cur = std::move(prop);
+      cur_cost = c;
+      stats->accepted++;
+      if (c < best_cost) {
+        best = cur;
+        best_cost = c;
+      }
+    }
+  }
+  return best;
+}
+
+// ---- driver ---------------------------------------------------------------
+
+Json spec_to_json(const Spec& s) {
+  Json arr = Json::array();
+  for (int8_t e : s)
+    arr.push_back(e == kData ? Json("data") : e == kModel ? Json("model") : Json());
+  return arr;
+}
+
+Json optimize(const Json& req) {
+  Graph g = Graph::from_json(req.get("nodes"));
+  MachineModel m = MachineModel::from_json(req.get("machine"));
+  SearchConfig cfg = SearchConfig::from_json(req.get("config"));
+  MeasuredCosts measured;
+  for (const auto& kv : req.get("measured").fields())
+    measured[kv.first] = kv.second.as_double();
+  double threshold = cfg.memory_threshold > 0 ? cfg.memory_threshold : m.hbm_cap;
+
+  // outer loop: mesh factorizations (MachineView enumeration analog)
+  std::vector<MeshShape> meshes;
+  int N = std::max(1, m.num_devices);
+  for (int mp = 1; mp <= N; ++mp) {
+    if (N % mp) continue;
+    int dp = N / mp;
+    // the host stages the batch sharded over 'data': dp must divide it
+    if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+    if (cfg.only_data_parallel && mp > 1) continue;
+    if (!cfg.enable_parameter_parallel && mp > 1) continue;
+    meshes.push_back({dp, mp});
+  }
+
+  double best_time = 1e30;
+  MeshShape best_mesh{N, 1};
+  Assignment best_assign;
+  std::vector<std::vector<Choice>> best_choices;
+  SimResult best_sim;
+  int64_t total_states = 0;
+  MCMCStats mcmc;
+
+  for (const MeshShape& mesh : meshes) {
+    auto choices = all_choices(g, mesh, cfg);
+    DPResult dp = dp_with_memory(g, choices, mesh, m, cfg, threshold);
+    total_states += dp.states;
+    if (!dp.ok) continue;
+    TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
+                           cfg.opt_state_factor, &measured);
+    Assignment a = dp.assign;
+    if (cfg.budget > 0)
+      a = mcmc_refine(g, choices, mesh, m, cfg, sim, a, threshold, &mcmc);
+    std::vector<Choice> cs;
+    for (size_t i = 0; i < a.size(); ++i) cs.push_back(choices[i][a[i]]);
+    SimResult sr = sim.simulate(cs);
+    if (threshold > 0 && sr.memory > threshold) continue;
+    if (sr.iteration_time < best_time) {
+      best_time = sr.iteration_time;
+      best_mesh = mesh;
+      best_assign = a;
+      best_choices = choices;
+      best_sim = sr;
+    }
+  }
+
+  Json out = Json::object();
+  if (best_assign.empty() && !g.nodes.empty()) {
+    out.set("error", "no feasible strategy (memory threshold too low?)");
+    return out;
+  }
+  Json meshj = Json::object();
+  meshj.set("data", Json((int64_t)best_mesh.dp));
+  meshj.set("model", Json((int64_t)best_mesh.mp));
+  out.set("mesh", meshj);
+  Json ops = Json::object();
+  for (size_t i = 0; i < g.nodes.size(); ++i) {
+    const Choice& c = best_choices[i][best_assign[i]];
+    Json oj = Json::object();
+    oj.set("choice", Json(c.name));
+    Json outs = Json::array();
+    for (const Spec& s : c.out) outs.push_back(spec_to_json(s));
+    oj.set("outputs", outs);
+    Json ins = Json::array();
+    for (const Spec& s : c.in) ins.push_back(spec_to_json(s));
+    oj.set("inputs", ins);
+    Json ps = Json::object();
+    for (const auto& kv : c.param) ps.set(kv.first, spec_to_json(kv.second));
+    oj.set("params", ps);
+    ops.set(std::to_string(g.nodes[i].guid), oj);
+  }
+  out.set("ops", ops);
+  out.set("predicted_time", Json(best_sim.iteration_time));
+  out.set("predicted_memory", Json(best_sim.memory));
+  Json stats = Json::object();
+  stats.set("states_explored", Json(total_states));
+  stats.set("mesh_candidates", Json((int64_t)meshes.size()));
+  stats.set("mcmc_iters", Json((int64_t)mcmc.iters));
+  stats.set("mcmc_accepted", Json((int64_t)mcmc.accepted));
+  stats.set("fwd_time", Json(best_sim.fwd_time));
+  stats.set("bwd_time", Json(best_sim.bwd_time));
+  stats.set("comm_time", Json(best_sim.comm_time));
+  stats.set("gradsync_time", Json(best_sim.gradsync_time));
+  out.set("stats", stats);
+  return out;
+}
+
+// Simulate a given assignment (for tests / what-if queries / --taskgraph).
+Json simulate_only(const Json& req) {
+  Graph g = Graph::from_json(req.get("nodes"));
+  MachineModel m = MachineModel::from_json(req.get("machine"));
+  SearchConfig cfg = SearchConfig::from_json(req.get("config"));
+  MeshShape mesh{(int)req.get("mesh").get("data").as_int(1),
+                 (int)req.get("mesh").get("model").as_int(1)};
+  auto choices = all_choices(g, mesh, cfg);
+  std::vector<Choice> cs;
+  const Json& sel = req.get("assignment");
+  for (size_t i = 0; i < g.nodes.size(); ++i) {
+    std::string want = sel.get(std::to_string(g.nodes[i].guid)).as_string();
+    const Choice* pick = nullptr;
+    for (const Choice& c : choices[i])
+      if (c.name == want) { pick = &c; break; }
+    if (pick == nullptr)
+      throw std::runtime_error("unknown/illegal choice '" + want +
+                               "' for op " + std::to_string(g.nodes[i].guid));
+    cs.push_back(*pick);
+  }
+  TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
+                         cfg.opt_state_factor, nullptr);
+  SimResult r = sim.simulate(cs);
+  Json out = Json::object();
+  out.set("iteration_time", Json(r.iteration_time));
+  out.set("memory", Json(r.memory));
+  out.set("fwd_time", Json(r.fwd_time));
+  out.set("bwd_time", Json(r.bwd_time));
+  out.set("comm_time", Json(r.comm_time));
+  out.set("gradsync_time", Json(r.gradsync_time));
+  Json tasks = Json::array();
+  for (const SimTask& t : r.tasks) {
+    Json tj = Json::object();
+    const char* kinds[] = {"fwd", "bwd", "comm", "gradsync", "update"};
+    tj.set("kind", Json(kinds[(int)t.kind]));
+    tj.set("node", Json((int64_t)t.node_idx));
+    tj.set("start", Json(t.start));
+    tj.set("finish", Json(t.finish));
+    tasks.push_back(tj);
+  }
+  out.set("tasks", tasks);
+  return out;
+}
+
+char* dup_string(const std::string& s) {
+  char* p = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+}  // namespace
+}  // namespace ffsearch
+
+extern "C" {
+
+const char* ffs_version() { return "ffsearch 0.1 (tpu-native unity search)"; }
+
+// Returns malloc'd JSON string; caller frees with ffs_free.
+char* ffs_optimize(const char* request_json) {
+  try {
+    ffsearch::Json req = ffsearch::Json::parse(request_json);
+    return ffsearch::dup_string(ffsearch::optimize(req).dump());
+  } catch (const std::exception& e) {
+    ffsearch::Json err = ffsearch::Json::object();
+    err.set("error", ffsearch::Json(std::string(e.what())));
+    return ffsearch::dup_string(err.dump());
+  }
+}
+
+char* ffs_simulate(const char* request_json) {
+  try {
+    ffsearch::Json req = ffsearch::Json::parse(request_json);
+    return ffsearch::dup_string(ffsearch::simulate_only(req).dump());
+  } catch (const std::exception& e) {
+    ffsearch::Json err = ffsearch::Json::object();
+    err.set("error", ffsearch::Json(std::string(e.what())));
+    return ffsearch::dup_string(err.dump());
+  }
+}
+
+void ffs_free(char* p) { free(p); }
+
+}  // extern "C"

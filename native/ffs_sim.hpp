@@ -1,0 +1,180 @@
+// Event-driven taskgraph simulator.
+//
+// Analog of the reference's Simulator::simulate_runtime
+// (src/runtime/simulator.cc:822-900): build a SimTask DAG for one training
+// iteration — forward per op, backward per op (reverse order), resharding
+// collectives on edges, partial-sum collectives, per-parameter gradient
+// all-reduce, optimizer update — then list-schedule it on two streams per
+// chip (compute, ICI) reflecting how XLA overlaps async collectives with
+// compute. SPMD symmetry means one chip's schedule is the iteration time.
+//
+// The reference's `search_overlap_backward_update` flag (config.h:130)
+// maps to `overlap`: when false, gradient all-reduces wait for the whole
+// backward pass (no overlap), as in its default Legion schedule.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "ffs_graph.hpp"
+#include "ffs_machine.hpp"
+#include "ffs_strategy.hpp"
+
+namespace ffsearch {
+
+struct SimTask {
+  enum class Kind { Fwd, Bwd, Comm, GradSync, Update };
+  Kind kind;
+  int node_idx = -1;  // -1 for Update
+  double duration = 0;
+  std::vector<int> deps;  // indices into task vector
+  // filled by the scheduler:
+  double start = 0, finish = 0;
+};
+
+struct SimResult {
+  double iteration_time = 0;
+  double fwd_time = 0, bwd_time = 0, comm_time = 0, gradsync_time = 0;
+  double memory = 0;  // per-device bytes
+  std::vector<SimTask> tasks;  // schedule (for --taskgraph export)
+};
+
+class TaskgraphSimulator {
+ public:
+  TaskgraphSimulator(const Graph& g, const MachineModel& m, const MeshShape& mesh,
+                     bool training = true, bool overlap = true,
+                     double opt_state_factor = 2.0,
+                     const MeasuredCosts* measured = nullptr)
+      : g_(g), m_(m), mesh_(mesh), training_(training), overlap_(overlap),
+        opt_state_factor_(opt_state_factor), measured_(measured) {}
+
+  // `assign[i]` = chosen Choice for g_.nodes[i].
+  SimResult simulate(const std::vector<Choice>& assign) const {
+    const size_t N = g_.nodes.size();
+    std::vector<SimTask> tasks;
+    std::vector<int> fwd_id(N, -1), bwd_id(N, -1);
+    auto add = [&](SimTask t) {
+      tasks.push_back(std::move(t));
+      return static_cast<int>(tasks.size()) - 1;
+    };
+
+    SimResult res;
+    // ---- forward + edge reshard tasks ----
+    for (size_t i = 0; i < N; ++i) {
+      const Node& n = g_.nodes[i];
+      const Choice& c = assign[i];
+      NodeCost nc = node_cost(n, c, mesh_, m_, training_);
+      if (measured_) {
+        auto it = measured_->find(std::to_string(n.guid) + ":" + c.name);
+        if (it != measured_->end()) {
+          nc.fwd = it->second / std::max(1.0, c.work_div);
+          nc.bwd = training_ ? 2.0 * nc.fwd : 0.0;
+        }
+      }
+      std::vector<int> deps;
+      for (size_t slot = 0; slot < n.inputs.size(); ++slot) {
+        const EdgeRef& e = n.inputs[slot];
+        if (e.src_guid < 0) continue;
+        int pi = g_.index_of.at(e.src_guid);
+        const Choice& pc = assign[pi];
+        const Spec& prod = pc.out[e.src_idx];
+        const Spec& need = slot < c.in.size() ? c.in[slot]
+                                              : rep_spec(prod.size());
+        double rb = reshard_cost(prod, need,
+                                 (double)g_.nodes[pi].output_bytes(e.src_idx),
+                                 mesh_, m_);
+        if (rb > 0) {
+          SimTask ct{SimTask::Kind::Comm, (int)i, rb, {fwd_id[pi]}};
+          deps.push_back(add(std::move(ct)));
+          res.comm_time += rb;
+        } else {
+          deps.push_back(fwd_id[pi]);
+        }
+      }
+      SimTask ft{SimTask::Kind::Fwd, (int)i, nc.fwd, deps};
+      fwd_id[i] = add(std::move(ft));
+      res.fwd_time += nc.fwd;
+      if (c.psum_bytes > 0 && c.psum_k > 1) {
+        double t = m_.allreduce_time(c.psum_bytes, c.psum_k);
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        fwd_id[i] = add(std::move(ct));  // consumers wait on the psum
+        res.comm_time += t;
+      }
+      res.memory += node_memory(n, c, mesh_, opt_state_factor_);
+    }
+
+    if (training_) {
+      // ---- backward (reverse topo): bwd_i after bwd of all consumers ----
+      for (int i = static_cast<int>(N) - 1; i >= 0; --i) {
+        const Node& n = g_.nodes[i];
+        const Choice& c = assign[i];
+        NodeCost nc = node_cost(n, c, mesh_, m_, true);
+        std::vector<int> deps = {fwd_id[i]};
+        auto it = g_.consumers.find(n.guid);
+        if (it != g_.consumers.end())
+          for (const auto& cons : it->second)
+            if (bwd_id[cons.first] >= 0) deps.push_back(bwd_id[cons.first]);
+        double dur = nc.bwd + (c.psum_k > 1 && c.psum_bytes > 0
+                                   ? m_.allreduce_time(c.psum_bytes, c.psum_k)
+                                   : 0.0);
+        SimTask bt{SimTask::Kind::Bwd, i, dur, deps};
+        bwd_id[i] = add(std::move(bt));
+        res.bwd_time += dur;
+      }
+      // ---- per-parameter gradient sync + optimizer update ----
+      std::vector<int> sync_ids;
+      int last_bwd = bwd_id[0];
+      for (size_t i = 0; i < N; ++i) {
+        const Choice& c = assign[i];
+        if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {
+          double t = m_.allreduce_time(c.gradsync_bytes, c.gradsync_k);
+          std::vector<int> deps = {bwd_id[i]};
+          if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
+          SimTask st{SimTask::Kind::GradSync, (int)i, t, deps};
+          sync_ids.push_back(add(std::move(st)));
+          res.gradsync_time += t;
+        }
+      }
+      double upd_bytes = 0;
+      for (size_t i = 0; i < N; ++i)
+        upd_bytes += (double)g_.nodes[i].param_bytes() *
+                     (1.0 + opt_state_factor_);
+      std::vector<int> deps = sync_ids;
+      if (last_bwd >= 0) deps.push_back(last_bwd);
+      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / m_.hbm_bw, deps};
+      add(std::move(ut));
+    }
+
+    // ---- list schedule on {compute, comm} streams ----
+    double compute_free = 0, comm_free = 0, makespan = 0;
+    for (auto& t : tasks) {
+      double ready = 0;
+      for (int d : t.deps)
+        if (d >= 0) ready = std::max(ready, tasks[d].finish);
+      bool on_comm = t.kind == SimTask::Kind::Comm ||
+                     t.kind == SimTask::Kind::GradSync;
+      double& stream = on_comm ? comm_free : compute_free;
+      t.start = std::max(ready, stream);
+      t.finish = t.start + t.duration;
+      stream = t.finish;
+      makespan = std::max(makespan, t.finish);
+    }
+    res.iteration_time = makespan;
+    res.tasks = std::move(tasks);
+    return res;
+  }
+
+ private:
+  const Graph& g_;
+  const MachineModel& m_;
+  MeshShape mesh_;
+  bool training_;
+  bool overlap_;
+  double opt_state_factor_;
+  const MeasuredCosts* measured_;
+};
+
+}  // namespace ffsearch

@@ -1,0 +1,337 @@
+// Sharding-choice enumeration + resharding cost model.
+//
+// This is the TPU-native re-expression of the reference's substitution
+// generators (src/runtime/substitution.cc:1726-1860): where the reference
+// rewrites the PCG to insert Repartition/Replicate/Combine/Reduction ops
+// around Linear/Attention/Conv (create_partition_linear_combine,
+// create_replicate_linear_combine, create_partition_attention_combine, ...),
+// we enumerate the *sharding choices* those rewrites produce directly:
+//
+//   dp       = partition sample dim              (Repartition on batch)
+//   dp_col   = column-parallel weights           (Partition(out-dim)+Combine)
+//   dp_row   = row-parallel weights + psum       (Replicate(in)+Reduction)
+//   dp_head  = attribute parallelism over heads  (Partition(head)+Combine)
+//   rep      = fully replicated
+//
+// An edge whose producer spec != consumer required spec carries a reshard
+// cost — the GSPMD collective that the reference's parallel ops performed
+// as Legion region copies.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "ffs_graph.hpp"
+#include "ffs_machine.hpp"
+
+namespace ffsearch {
+
+// Axis ids in a Spec entry: -1 replicated, 0 = 'data' axis, 1 = 'model' axis.
+constexpr int8_t kRep = -1;
+constexpr int8_t kData = 0;
+constexpr int8_t kModel = 1;
+
+using Spec = std::vector<int8_t>;
+
+struct MeshShape {
+  int dp = 1;
+  int mp = 1;
+  int axis_size(int8_t axis) const { return axis == kData ? dp : axis == kModel ? mp : 1; }
+};
+
+inline Spec rep_spec(size_t rank) { return Spec(rank, kRep); }
+
+inline int shards_of(const Spec& s, const MeshShape& mesh) {
+  int k = 1;
+  for (int8_t e : s)
+    if (e >= 0) k *= mesh.axis_size(e);
+  return k;
+}
+
+struct Choice {
+  std::string name;
+  std::vector<Spec> out;               // per output tensor
+  std::vector<Spec> in;                // required spec per input tensor
+  std::map<std::string, Spec> param;   // per parameter
+  double work_div = 1.0;               // compute FLOPs divided by this
+  double psum_bytes = 0.0;             // partial-sum bytes reduced over model axis
+  int psum_k = 1;
+  double gradsync_bytes = 0.0;         // per-iteration gradient allreduce bytes
+  int gradsync_k = 1;                  // chips in the gradient ring (dp)
+};
+
+// ---- reshard cost ---------------------------------------------------------
+
+// Cost of transforming a tensor of `global_bytes` laid out as `a` into
+// layout `b`. Approximations follow §2.3's op→collective mapping.
+inline double reshard_cost(const Spec& a, const Spec& b, double global_bytes,
+                           const MeshShape& mesh, const MachineModel& m) {
+  if (a == b) return 0.0;
+  int ka = shards_of(a, mesh), kb = shards_of(b, mesh);
+  if (ka <= 1 && kb <= 1) return 0.0;
+  // (dim, axis) pairs
+  std::set<std::pair<int, int8_t>> sa, sb;
+  for (size_t i = 0; i < a.size(); ++i) if (a[i] >= 0) sa.insert({(int)i, a[i]});
+  for (size_t i = 0; i < b.size(); ++i) if (b[i] >= 0) sb.insert({(int)i, b[i]});
+  bool a_in_b = std::includes(sb.begin(), sb.end(), sa.begin(), sa.end());
+  if (a_in_b) return 0.0;  // pure additional slicing: local
+  bool b_in_a = std::includes(sa.begin(), sa.end(), sb.begin(), sb.end());
+  int k_keep = 1;
+  for (const auto& p : sa)
+    if (sb.count(p)) k_keep *= mesh.axis_size(p.second);
+  int kg = std::max(1, ka / k_keep);  // group size that must communicate
+  if (b_in_a) {
+    // all-gather: each chip ends with B/kb bytes, (1 - kb/ka) arriving remotely
+    double out_bytes = global_bytes / kb;
+    double frac = 1.0 - static_cast<double>(kb) / ka;
+    return m.ici_latency * (kg - 1) + out_bytes * frac / m.ring_bw();
+  }
+  // mixed: all-to-all within the communicating group
+  double per_chip = std::max(global_bytes / ka, global_bytes / kb);
+  return m.ici_latency + per_chip * (kg - 1) / kg / m.ring_bw();
+}
+
+// ---- choice enumeration ---------------------------------------------------
+
+namespace detail {
+
+inline bool div_ok(int64_t size, int k) { return k > 0 && size % k == 0; }
+
+// Spec for "shard sample dim 0 on data" given shape; kRep everywhere else.
+inline Spec dp_spec(const Shape& shp, int dp) {
+  Spec s = rep_spec(shp.size());
+  if (!shp.empty() && dp > 1 && div_ok(shp[0], dp)) s[0] = kData;
+  return s;
+}
+
+inline double pbytes(const Node& n) { return (double)n.param_bytes(); }
+
+}  // namespace detail
+
+// Enumerate the legal sharding choices of `n` on mesh (dp, mp).
+// `enable_pp` gates parameter/attribute parallelism
+// (--enable-parameter-parallel, reference model.cc:3612).
+inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mesh,
+                                             bool enable_pp) {
+  using detail::div_ok;
+  using detail::dp_spec;
+  const int dp = mesh.dp, mp = mesh.mp;
+  std::vector<Choice> out;
+  const Shape& oshp = n.output_shapes.empty() ? Shape{} : n.output_shapes[0];
+  const size_t orank = oshp.size();
+  int64_t batch = orank ? oshp[0] : 0;
+  bool sample0 = !n.roles.empty() && !n.roles[0].empty() &&
+                 n.roles[0][0] == Role::Sample;
+
+  auto base_choice = [&](const std::string& name) {
+    Choice c;
+    c.name = name;
+    for (const auto& s : n.output_shapes) c.out.push_back(rep_spec(s.size()));
+    for (const auto& s : n.input_shapes) c.in.push_back(rep_spec(s.size()));
+    for (const auto& kv : n.params) c.param[kv.first] = rep_spec(kv.second.size());
+    return c;
+  };
+
+  // choice 0: fully replicated — always legal
+  out.push_back(base_choice("rep"));
+
+  bool dp_legal = sample0 && dp > 1 && div_ok(batch, dp);
+  auto make_dp = [&]() {
+    Choice c = base_choice("dp");
+    for (size_t i = 0; i < n.output_shapes.size(); ++i)
+      c.out[i] = dp_spec(n.output_shapes[i], dp);
+    for (size_t i = 0; i < n.input_shapes.size(); ++i) {
+      // shard inputs that carry the same batch extent on dim 0
+      const Shape& is = n.input_shapes[i];
+      if (!is.empty() && is[0] == batch) c.in[i] = dp_spec(is, dp);
+    }
+    c.work_div = dp;
+    c.gradsync_bytes = detail::pbytes(n);
+    c.gradsync_k = dp;
+    return c;
+  };
+  if (dp_legal) out.push_back(make_dp());
+
+  const bool pp = enable_pp && mp > 1;
+  const std::string& t = n.type;
+
+  if (t == "LINEAR" && pp) {
+    auto kit = n.params.find("kernel");
+    if (kit != n.params.end() && kit->second.size() == 2) {
+      int64_t in_dim = kit->second[0], out_dim = kit->second[1];
+      int eff_dp = dp_legal ? dp : 1;
+      if (div_ok(out_dim, mp)) {  // column parallel: Partition(out)+Combine
+        Choice c = dp_legal ? make_dp() : base_choice("col");
+        c.name = dp_legal ? "dp_col" : "col";
+        c.param["kernel"] = {kRep, kModel};
+        if (c.param.count("bias")) c.param["bias"] = {kModel};
+        c.out[0].back() = kModel;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+      if (div_ok(in_dim, mp)) {  // row parallel: Replicate+Reduction (psum)
+        Choice c = dp_legal ? make_dp() : base_choice("row");
+        c.name = dp_legal ? "dp_row" : "row";
+        c.param["kernel"] = {kModel, kRep};
+        c.in[0].back() = kModel;
+        // output stays unsharded on model: psum of partials
+        c.psum_bytes = (double)n.output_bytes(0) / eff_dp;
+        c.psum_k = mp;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+    }
+  } else if (t == "EMBEDDING" && pp) {
+    auto kit = n.params.find("kernel");
+    if (kit != n.params.end() && kit->second.size() == 2) {
+      int64_t vocab = kit->second[0], edim = kit->second[1];
+      int eff_dp = dp_legal ? dp : 1;
+      if (div_ok(edim, mp)) {
+        Choice c = dp_legal ? make_dp() : base_choice("col");
+        c.name = dp_legal ? "dp_col" : "col";
+        c.param["kernel"] = {kRep, kModel};
+        c.out[0].back() = kModel;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+      if (div_ok(vocab, mp)) {  // vocab-sharded: masked lookup + psum
+        Choice c = dp_legal ? make_dp() : base_choice("row");
+        c.name = dp_legal ? "dp_row" : "row";
+        c.param["kernel"] = {kModel, kRep};
+        c.psum_bytes = (double)n.output_bytes(0) / eff_dp;
+        c.psum_k = mp;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+    }
+  } else if (t == "CONV2D" && pp && n.attrs.get("groups").as_int(1) == 1) {
+    auto kit = n.params.find("kernel");  // OIHW
+    if (kit != n.params.end() && kit->second.size() == 4) {
+      int64_t oc = kit->second[0], ic = kit->second[1];
+      int eff_dp = dp_legal ? dp : 1;
+      if (div_ok(oc, mp)) {
+        Choice c = dp_legal ? make_dp() : base_choice("col");
+        c.name = dp_legal ? "dp_col" : "col";
+        c.param["kernel"] = {kModel, kRep, kRep, kRep};
+        if (c.param.count("bias")) c.param["bias"] = {kModel};
+        if (c.out[0].size() == 4) c.out[0][1] = kModel;  // NCHW channel
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+      if (div_ok(ic, mp)) {
+        Choice c = dp_legal ? make_dp() : base_choice("row");
+        c.name = dp_legal ? "dp_row" : "row";
+        c.param["kernel"] = {kRep, kModel, kRep, kRep};
+        if (c.in[0].size() == 4) c.in[0][1] = kModel;
+        c.psum_bytes = (double)n.output_bytes(0) / eff_dp;
+        c.psum_k = mp;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+    }
+  } else if (t == "MULTIHEAD_ATTENTION" && pp) {
+    int64_t heads = n.attrs.get("num_heads").as_int(0);
+    if (heads > 0 && div_ok(heads, mp)) {
+      // attribute parallelism: shard the head axis of every weight whose
+      // dim 0 == num_heads (wq/wk/wv [H,E,D], wo [H,D,E]) — the reference's
+      // create_partition_attention_combine (substitution.cc:1764)
+      int eff_dp = dp_legal ? dp : 1;
+      Choice c = dp_legal ? make_dp() : base_choice("head");
+      c.name = dp_legal ? "dp_head" : "head";
+      bool any = false;
+      for (const auto& kv : n.params) {
+        if (!kv.second.empty() && kv.second[0] == heads) {
+          Spec s = rep_spec(kv.second.size());
+          s[0] = kModel;
+          c.param[kv.first] = s;
+          any = true;
+        }
+      }
+      if (any) {
+        c.psum_bytes = (double)n.output_bytes(0) / eff_dp;  // output proj psum
+        c.psum_k = mp;
+        c.work_div = static_cast<double>(eff_dp) * mp;
+        c.gradsync_bytes = detail::pbytes(n) / mp;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+    }
+  } else if ((t.rfind("EW_", 0) == 0 || t == "RELU" || t == "GELU" ||
+              t == "SIGMOID" || t == "TANH" || t == "ELU" || t == "EXP" ||
+              t == "SIN" || t == "COS" || t == "POW" || t == "RSQRT" ||
+              t == "IDENTITY" || t == "DROPOUT" || t == "CAST" ||
+              t.rfind("SCALAR_", 0) == 0) && pp && orank >= 2 &&
+             div_ok(oshp.back(), mp)) {
+    // follow-style ops can also carry a model-sharded last dim so a
+    // col-parallel producer's layout flows through without a gather
+    Choice c = dp_legal ? make_dp() : base_choice("mp_last");
+    c.name = dp_legal ? "dp_mp_last" : "mp_last";
+    c.out[0].back() = kModel;
+    for (size_t i = 0; i < n.input_shapes.size(); ++i) {
+      const Shape& is = n.input_shapes[i];
+      if (!is.empty() && is.back() == oshp.back()) c.in[i].back() = kModel;
+    }
+    c.work_div = static_cast<double>(dp_legal ? dp : 1) * mp;
+    out.push_back(std::move(c));
+  }
+  return out;
+}
+
+// ---- per-node cost given a choice ----------------------------------------
+
+struct NodeCost {
+  double fwd = 0, bwd = 0, comm = 0, gradsync = 0;
+  double total() const { return fwd + bwd + comm + gradsync; }
+};
+
+inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
+                          const MachineModel& m, bool training) {
+  NodeCost nc;
+  double div = std::max(1.0, c.work_div);
+  double flop = n.fwd_flops / div;
+  double bytes = (double)n.total_io_bytes() / div;
+  nc.fwd = m.compute_time(flop, bytes, n.dtype_size);
+  if (training) nc.bwd = 2.0 * nc.fwd;  // dX + dW passes
+  if (c.psum_bytes > 0 && c.psum_k > 1) {
+    double t = m.allreduce_time(c.psum_bytes, c.psum_k);
+    nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
+  }
+  if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
+    nc.gradsync = m.allreduce_time(c.gradsync_bytes, c.gradsync_k);
+  return nc;
+}
+
+// Per-device memory of a node under a choice: sharded params (+optimizer
+// state) + sharded activations (kept for backward).
+inline double node_memory(const Node& n, const Choice& c, const MeshShape& mesh,
+                          double opt_state_factor) {
+  double mem = 0;
+  for (const auto& kv : n.params) {
+    auto it = c.param.find(kv.first);
+    int k = it != c.param.end() ? shards_of(it->second, mesh) : 1;
+    mem += (double)shape_elems(kv.second) * n.dtype_size / k * (1.0 + opt_state_factor);
+  }
+  for (size_t i = 0; i < n.output_shapes.size(); ++i) {
+    int k = i < c.out.size() ? shards_of(c.out[i], mesh) : 1;
+    mem += (double)n.output_bytes(i) / k;
+  }
+  return mem;
+}
+
+}  // namespace ffsearch
