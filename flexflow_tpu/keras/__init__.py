@@ -1,0 +1,19 @@
+"""Keras-compatible frontend (python/flexflow/keras analog).
+
+Usage mirrors tf.keras / the reference's flexflow.keras:
+
+    from flexflow_tpu.keras import Sequential
+    from flexflow_tpu.keras.layers import Dense, Input
+
+    model = Sequential([Input((784,)), Dense(128, activation="relu"),
+                        Dense(10, activation="softmax")])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=64)
+    model.fit(x, y, epochs=5)
+"""
+
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras import layers, optimizers, callbacks, datasets, backend
+
+__all__ = ["Model", "Sequential", "layers", "optimizers", "callbacks",
+           "datasets", "backend"]

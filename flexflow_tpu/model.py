@@ -495,9 +495,7 @@ class FFModel:
 
     # ======================= data staging ==================================
     def _shard_batch(self, arr: np.ndarray) -> jax.Array:
-        da = self.executor.data_axes
-        sharding = NamedSharding(self.mesh, P(da) if da else P())
-        return jax.device_put(jnp.asarray(arr), sharding)
+        return jax.device_put(jnp.asarray(arr), self.executor.batch_sharding())
 
     def _stage_inputs(self, xs) -> Dict[str, jax.Array]:
         if not isinstance(xs, (list, tuple)):
@@ -538,6 +536,7 @@ class FFModel:
                     jnp.add, mtotals, mvals)
             self._metrics_acc.update(
                 {k: v for k, v in (mtotals or {}).items()}, bs * num_batches)
+            self._last_loss = float(loss)
             if verbose:
                 rep = self._metrics_acc.report()
                 print(f"epoch {epoch}: loss={float(loss):.4f} " +

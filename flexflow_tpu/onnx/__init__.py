@@ -1,0 +1,5 @@
+"""ONNX frontend (python/flexflow/onnx/model.py analog)."""
+
+from flexflow_tpu.onnx.model import ONNXModel
+
+__all__ = ["ONNXModel"]

@@ -1,0 +1,184 @@
+"""ONNX graph → FFModel translation.
+
+Analog of python/flexflow/onnx/model.py (375 LoC in the reference): walks
+``model.graph.node`` in order and emits the corresponding FFModel layer per
+ONNX op_type. The ``onnx`` package is optional in this environment (no
+pip installs): ``ONNXModel(path)`` requires it, but ``ONNXModel(model)``
+accepts any object with the ModelProto structure (``graph.node[*].op_type/
+input/output/attribute``), which is also how the unit tests drive the
+translation table devicelessly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from flexflow_tpu.ffconst import ActiMode, PoolType
+from flexflow_tpu.model import FFModel
+
+
+# AttributeProto.AttributeType values (onnx.proto): which field is live
+_ATTR_TYPE_FIELD = {1: "f", 2: "i", 3: "s", 6: "floats", 7: "ints"}
+
+
+def _attrs(node) -> Dict[str, Any]:
+    out = {}
+    for a in getattr(node, "attribute", []):
+        atype = getattr(a, "type", None)
+        if atype in _ATTR_TYPE_FIELD:
+            # real protobuf: every field exists with a default — the type
+            # tag alone decides which one carries the value
+            fields = (_ATTR_TYPE_FIELD[atype],)
+        else:
+            # duck-typed stand-in (tests / no onnx package): first field
+            # actually set wins
+            fields = ("i", "f", "s", "ints", "floats")
+        for field in fields:
+            v = getattr(a, field, None)
+            if v is None:
+                continue
+            if field == "s" and isinstance(v, bytes):
+                v = v.decode()
+            if field in ("ints", "floats"):
+                v = list(v)
+            out[a.name] = v
+            break
+    return out
+
+
+class ONNXModel:
+    def __init__(self, model):
+        if isinstance(model, str):
+            try:
+                import onnx
+            except ImportError as e:  # pragma: no cover
+                raise ImportError(
+                    "the 'onnx' package is required to load .onnx files; "
+                    "pass a ModelProto-like object instead") from e
+            model = onnx.load(model)
+        self.model = model
+
+    def apply(self, ff: FFModel, input_tensors: Dict[str, Any]):
+        """Translate the graph; returns the tensor of the last node output.
+
+        ``input_tensors`` maps ONNX graph-input names to FFModel tensors.
+        """
+        env: Dict[str, Any] = dict(input_tensors)
+        out = None
+        for node in self.model.graph.node:
+            out = self._emit(ff, node, env)
+        return out
+
+    def _emit(self, ff: FFModel, node, env: Dict[str, Any]):
+        op = node.op_type
+        at = _attrs(node)
+        # data inputs only (weights come from initializers and are created
+        # by the FFModel layer itself)
+        ins = [env[i] for i in node.input if i in env]
+        name = node.output[0]
+
+        def done(t):
+            env[name] = t
+            return t
+
+        if op == "Gemm" or op == "MatMul":
+            # out_dim from the weight initializer is not available without
+            # the tensor data; FFModel needs it via attribute or env hint
+            out_dim = at.get("out_dim") or at.get("N")
+            if out_dim is None:
+                raise ValueError(
+                    f"{op} node {name}: provide 'out_dim' attribute (the "
+                    f"frontend does not read initializer payloads)")
+            return done(ff.dense(ins[0], int(out_dim),
+                                 use_bias=(op == "Gemm"), name=name))
+        if op == "Conv":
+            k = at.get("kernel_shape", [1, 1])
+            s = at.get("strides", [1, 1])
+            p = at.get("pads", [0, 0, 0, 0])
+            out_ch = at.get("out_channels")
+            if out_ch is None:
+                raise ValueError(f"Conv node {name}: provide 'out_channels'")
+            return done(ff.conv2d(ins[0], int(out_ch), k[0], k[1], s[0], s[1],
+                                  p[0], p[1], groups=int(at.get("group", 1)),
+                                  name=name))
+        if op in ("MaxPool", "AveragePool"):
+            k = at.get("kernel_shape", [2, 2])
+            s = at.get("strides", k)
+            p = at.get("pads", [0, 0, 0, 0])
+            pt = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
+            return done(ff.pool2d(ins[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                                  pool_type=pt, name=name))
+        if op == "GlobalAveragePool":
+            return done(ff.mean(ins[0], [2, 3], keepdims=True, name=name))
+        if op == "BatchNormalization":
+            return done(ff.batch_norm(ins[0], relu=False, name=name))
+        if op == "LayerNormalization":
+            return done(ff.layer_norm(ins[0], name=name))
+        if op == "Relu":
+            return done(ff.relu(ins[0], name=name))
+        if op == "Gelu":
+            return done(ff.gelu(ins[0], name=name))
+        if op == "Sigmoid":
+            return done(ff.sigmoid(ins[0], name=name))
+        if op == "Tanh":
+            return done(ff.tanh(ins[0], name=name))
+        if op == "Elu":
+            return done(ff.elu(ins[0], name=name))
+        if op == "Exp":
+            return done(ff.exp(ins[0], name=name))
+        if op == "Softmax":
+            return done(ff.softmax(ins[0], axis=int(at.get("axis", -1)),
+                                   name=name))
+        if op == "Dropout":
+            return done(ff.dropout(ins[0], float(at.get("ratio", 0.5)),
+                                   name=name))
+        if op == "Add":
+            return done(ff.add(ins[0], ins[1], name=name))
+        if op == "Sub":
+            return done(ff.subtract(ins[0], ins[1], name=name))
+        if op == "Mul":
+            return done(ff.multiply(ins[0], ins[1], name=name))
+        if op == "Div":
+            return done(ff.divide(ins[0], ins[1], name=name))
+        if op == "Max":
+            return done(ff.max(ins[0], ins[1], name=name))
+        if op == "Min":
+            return done(ff.min(ins[0], ins[1], name=name))
+        if op == "Concat":
+            return done(ff.concat(ins, int(at.get("axis", 0)), name=name))
+        if op == "Split":
+            sizes = at.get("split")
+            outs = ff.split(ins[0], sizes if sizes else len(node.output),
+                            int(at.get("axis", 0)), name=name)
+            for out_name, t in zip(node.output, outs):
+                env[out_name] = t
+            return outs
+        if op == "Flatten":
+            return done(ff.flat(ins[0], name=name))
+        if op == "Reshape":
+            shape = at.get("shape")
+            if shape is None:
+                raise ValueError(f"Reshape {name}: constant-input reshape "
+                                 f"needs 'shape' attribute")
+            batch = ins[0].shape[0]
+            shape = [batch if s in (0, -1) and i == 0 else int(s)
+                     for i, s in enumerate(shape)]
+            return done(ff.reshape(ins[0], shape, name=name))
+        if op == "Transpose":
+            return done(ff.transpose(ins[0], at.get("perm"), name=name))
+        if op == "Cast":
+            return done(ff.identity(ins[0], name=name))
+        if op == "ReduceMean":
+            return done(ff.mean(ins[0], at.get("axes", [-1]),
+                                keepdims=bool(at.get("keepdims", 1)),
+                                name=name))
+        if op == "ReduceSum":
+            return done(ff.reduce_sum(ins[0], at.get("axes", [-1]),
+                                      keepdims=bool(at.get("keepdims", 1)),
+                                      name=name))
+        if op == "Gather":
+            return done(ff.gather(ins[0], ins[1], axis=int(at.get("axis", 0)),
+                                  name=name))
+        if op == "Identity":
+            return done(ff.identity(ins[0], name=name))
+        raise NotImplementedError(f"ONNX op {op} has no translation")

@@ -139,6 +139,12 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
                 entries = op.preferred_spec_update(entries)
             entries = [e if _axis_entry_valid(e, axis_sizes) else None
                        for e in entries]
+            used = [e for e in entries if e is not None]
+            if len(used) != len(set(used)):
+                raise ValueError(
+                    f"parallel op '{op.name}' would shard two dims over the "
+                    f"same mesh axis ({entries}); repartition a dim that is "
+                    f"not already sharded on that axis")
             node.output_specs = [P(*entries)] + node.output_specs[1:]
             forced[op.guid] = entries
 

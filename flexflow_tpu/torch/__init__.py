@@ -1,0 +1,5 @@
+"""PyTorch frontend: torch.fx tracing → FFModel (python/flexflow/torch analog)."""
+
+from flexflow_tpu.torch.model import PyTorchModel, torch_to_ff_file
+
+__all__ = ["PyTorchModel", "torch_to_ff_file"]

@@ -1,0 +1,373 @@
+"""torch.fx → FFModel translation.
+
+Analog of python/flexflow/torch/model.py (reference :2408-2496): a
+``torch.nn.Module`` is traced with ``torch.fx.symbolic_trace``, each fx
+node is translated through a per-kind table (call_module / call_function /
+call_method) into FFModel layer calls, and the trained weights can be
+copied over so the TPU model starts from the torch initialization.
+
+Also provides the serialized-file path (reference README.md:16-22's
+``fx.torch_to_flexflow`` → ``.ff`` file): ``torch_to_ff_file`` writes a
+JSON description of the traced graph; ``PyTorchModel.from_file`` replays
+it without importing torch.
+"""
+
+from __future__ import annotations
+
+import json
+import operator
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType
+from flexflow_tpu.model import FFModel
+
+
+def _torch():
+    import torch  # deferred so the package imports without torch
+
+    return torch
+
+
+# ---- graph description (the .ff-file schema) ------------------------------
+
+def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
+    """One serializable op record per fx node."""
+    torch = _torch()
+    nn = torch.nn
+    F = torch.nn.functional
+
+    def arg_names(args):
+        out = []
+        for a in args:
+            if isinstance(a, torch.fx.Node):
+                out.append(["ref", a.name])
+            elif isinstance(a, (list, tuple)):
+                out.append(["list", arg_names(a)])
+            else:
+                out.append(["const", a])
+        return out
+
+    d: Dict[str, Any] = {"name": node.name, "op": node.op,
+                         "args": arg_names(node.args)}
+    d["kwargs"] = {k: (["ref", v.name] if isinstance(v, torch.fx.Node)
+                       else ["const", v if not isinstance(v, torch.Size)
+                             else list(v)])
+                   for k, v in node.kwargs.items()}
+    if node.op == "call_module":
+        mod = dict(module.named_modules())[node.target]
+        d["target"] = type(mod).__name__
+        cfg: Dict[str, Any] = {}
+        if isinstance(mod, nn.Linear):
+            cfg = dict(out_features=mod.out_features,
+                       in_features=mod.in_features, bias=mod.bias is not None)
+        elif isinstance(mod, nn.Conv2d):
+            cfg = dict(out_channels=mod.out_channels,
+                       kernel_size=list(mod.kernel_size),
+                       stride=list(mod.stride), padding=list(mod.padding),
+                       groups=mod.groups, bias=mod.bias is not None)
+        elif isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+            k = mod.kernel_size
+            s = mod.stride or k
+            p = mod.padding
+            norm = lambda v: list(v) if isinstance(v, (tuple, list)) else [v, v]
+            cfg = dict(kernel_size=norm(k), stride=norm(s), padding=norm(p),
+                       pool="max" if isinstance(mod, nn.MaxPool2d) else "avg")
+        elif isinstance(mod, nn.BatchNorm2d):
+            cfg = dict(num_features=mod.num_features)
+        elif isinstance(mod, nn.LayerNorm):
+            cfg = dict(normalized_shape=list(mod.normalized_shape),
+                       eps=mod.eps)
+        elif isinstance(mod, nn.Embedding):
+            cfg = dict(num_embeddings=mod.num_embeddings,
+                       embedding_dim=mod.embedding_dim)
+        elif isinstance(mod, nn.Dropout):
+            cfg = dict(p=mod.p)
+        elif isinstance(mod, nn.MultiheadAttention):
+            cfg = dict(embed_dim=mod.embed_dim, num_heads=mod.num_heads,
+                       batch_first=getattr(mod, "batch_first", False))
+        elif isinstance(mod, nn.Softmax):
+            cfg = dict(dim=mod.dim)
+        elif isinstance(mod, nn.Flatten):
+            cfg = dict(start_dim=mod.start_dim)
+        elif isinstance(mod, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh,
+                              nn.Identity)):
+            cfg = {}
+        else:
+            raise NotImplementedError(
+                f"torch module {type(mod).__name__} has no translation")
+        d["config"] = cfg
+    elif node.op in ("call_function", "call_method"):
+        t = node.target
+        d["target"] = t if isinstance(t, str) else getattr(t, "__name__", str(t))
+    elif node.op == "placeholder":
+        d["target"] = node.name
+        d["shape"] = list(shapes.get(node.name, ()))
+    elif node.op == "output":
+        d["target"] = "output"
+    return d
+
+
+def trace_module(module, input_shapes: Dict[str, Sequence[int]],
+                 batch_size: int) -> List[Dict[str, Any]]:
+    torch = _torch()
+    traced = torch.fx.symbolic_trace(module)
+    shapes = {k: tuple(v) for k, v in input_shapes.items()}
+    return [_node_desc_from_fx(module, n, shapes) for n in traced.graph.nodes]
+
+
+def torch_to_ff_file(module, path: str, input_shapes: Dict[str, Sequence[int]],
+                     batch_size: int = 1) -> None:
+    """Serialize the traced graph to a ``.ff`` JSON file
+    (reference fx.torch_to_flexflow analog)."""
+    descs = trace_module(module, input_shapes, batch_size)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "nodes": descs}, f, indent=1)
+
+
+# ---- translation to FFModel ----------------------------------------------
+
+class PyTorchModel:
+    """Wraps a torch.nn.Module (or a .ff file) and builds the FFModel graph.
+
+    ``torch_to_ff(ffmodel, input_tensors)`` mirrors the reference's method
+    of the same name (torch/model.py:2408): returns the output tensors.
+    """
+
+    def __init__(self, module=None, descs: Optional[List[Dict]] = None):
+        self.module = module
+        self._descs = descs
+
+    @classmethod
+    def from_file(cls, path: str) -> "PyTorchModel":
+        with open(path) as f:
+            return cls(descs=json.load(f)["nodes"])
+
+    def descs(self, input_shapes, batch_size) -> List[Dict[str, Any]]:
+        if self._descs is not None:
+            return self._descs
+        return trace_module(self.module, input_shapes, batch_size)
+
+    def torch_to_ff(self, ff: FFModel, input_tensors: Sequence,
+                    input_names: Optional[Sequence[str]] = None):
+        inputs = list(input_tensors)
+        shapes = {}
+        descs = self.descs(shapes, inputs[0].shape[0] if inputs else 1)
+        env: Dict[str, Any] = {}
+        placeholders = [d for d in descs if d["op"] == "placeholder"]
+        if input_names is None:
+            input_names = [d["name"] for d in placeholders]
+        for name, t in zip(input_names, inputs):
+            env[name] = t
+        outputs = None
+
+        def resolve(a):
+            kind, v = a
+            if kind == "ref":
+                return env[v]
+            if kind == "list":
+                return [resolve(x) for x in v]
+            return v
+
+        for d in descs:
+            if d["op"] == "placeholder":
+                continue
+            if d["op"] == "output":
+                outputs = resolve(d["args"][0])
+                break
+            args = [resolve(a) for a in d["args"]]
+            kwargs = {k: resolve(v) for k, v in d.get("kwargs", {}).items()}
+            env[d["name"]] = self._emit(ff, d, args, kwargs)
+        self._env = env
+        return outputs
+
+    def _emit(self, ff: FFModel, d: Dict, args: List, kwargs: Dict):
+        op, target = d["op"], d.get("target")
+        cfg = d.get("config", {})
+        name = d["name"]
+        if op == "call_module":
+            if target == "Linear":
+                return ff.dense(args[0], cfg["out_features"],
+                                use_bias=cfg.get("bias", True), name=name)
+            if target == "Conv2d":
+                kh, kw = cfg["kernel_size"]
+                sh, sw = cfg["stride"]
+                ph, pw = cfg["padding"]
+                return ff.conv2d(args[0], cfg["out_channels"], kh, kw, sh, sw,
+                                 ph, pw, groups=cfg.get("groups", 1),
+                                 use_bias=cfg.get("bias", True), name=name)
+            if target in ("MaxPool2d", "AvgPool2d"):
+                from flexflow_tpu.ffconst import PoolType
+
+                kh, kw = cfg["kernel_size"]
+                sh, sw = cfg["stride"]
+                ph, pw = cfg["padding"]
+                pt = (PoolType.POOL_MAX if cfg.get("pool") == "max"
+                      else PoolType.POOL_AVG)
+                return ff.pool2d(args[0], kh, kw, sh, sw, ph, pw,
+                                 pool_type=pt, name=name)
+            if target == "BatchNorm2d":
+                return ff.batch_norm(args[0], relu=False, name=name)
+            if target == "LayerNorm":
+                nd = len(cfg["normalized_shape"])
+                return ff.layer_norm(args[0],
+                                     axes=tuple(range(-nd, 0)),
+                                     eps=cfg.get("eps", 1e-5), name=name)
+            if target == "Embedding":
+                return ff.embedding(args[0], cfg["num_embeddings"],
+                                    cfg["embedding_dim"], name=name)
+            if target == "Dropout":
+                return ff.dropout(args[0], cfg.get("p", 0.5), name=name)
+            if target == "Softmax":
+                return ff.softmax(args[0], axis=cfg.get("dim", -1), name=name)
+            if target == "Flatten":
+                return ff.flat(args[0], name=name)
+            if target == "MultiheadAttention":
+                q, k, v = (args + [args[0], args[0]])[:3]
+                return ff.multihead_attention(
+                    q, k, v, cfg["embed_dim"], cfg["num_heads"], name=name)
+            if target == "ReLU":
+                return ff.relu(args[0], name=name)
+            if target == "GELU":
+                return ff.gelu(args[0], name=name)
+            if target == "Sigmoid":
+                return ff.sigmoid(args[0], name=name)
+            if target == "Tanh":
+                return ff.tanh(args[0], name=name)
+            if target == "Identity":
+                return ff.identity(args[0], name=name)
+        elif op in ("call_function", "call_method"):
+            return self._emit_function(ff, target, args, kwargs, name)
+        raise NotImplementedError(f"fx node {op}:{target} has no translation")
+
+    def _emit_function(self, ff: FFModel, target: str, args, kwargs, name):
+        binop = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
+                 "truediv": ff.divide, "maximum": ff.max, "minimum": ff.min}
+        if target in binop:
+            a, b = args[0], args[1]
+            from flexflow_tpu.tensor import Tensor as FFTensor
+
+            if isinstance(a, FFTensor) and isinstance(b, FFTensor):
+                return binop[target](a, b, name=name)
+            if isinstance(a, FFTensor):  # tensor (op) scalar
+                scalar_op = {"add": ff.scalar_add, "sub": ff.scalar_sub,
+                             "mul": ff.scalar_multiply,
+                             "truediv": ff.scalar_true_divide}[target]
+                return scalar_op(a, float(b), name=name)
+            # scalar (op) tensor — sub/div are not commutative
+            s, t = float(a), b
+            if target == "add":
+                return ff.scalar_add(t, s, name=name)
+            if target == "mul":
+                return ff.scalar_multiply(t, s, name=name)
+            if target == "sub":  # s - x = -x + s
+                neg = ff.scalar_multiply(t, -1.0, name=f"{name}_neg")
+                return ff.scalar_add(neg, s, name=name)
+            if target == "truediv":  # s / x = s * x^-1
+                inv = ff.pow(t, -1.0, name=f"{name}_inv")
+                return ff.scalar_multiply(inv, s, name=name)
+            raise NotImplementedError(f"scalar-left {target}")
+        if target in ("relu", "relu_"):
+            return ff.relu(args[0], name=name)
+        if target == "gelu":
+            return ff.gelu(args[0], name=name)
+        if target == "sigmoid":
+            return ff.sigmoid(args[0], name=name)
+        if target == "tanh":
+            return ff.tanh(args[0], name=name)
+        if target == "softmax":
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=axis if axis is not None else -1,
+                              name=name)
+        if target == "cat":
+            ts = args[0]
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(ts, axis, name=name)
+        if target == "flatten":
+            return ff.flat(args[0], name=name)
+        if target in ("reshape", "view"):
+            shape = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
+            batch = args[0].shape[0]
+            shape = [batch if s == -1 and i == 0 else s
+                     for i, s in enumerate(shape)]
+            return ff.reshape(args[0], shape, name=name)
+        if target in ("transpose", "permute"):
+            x = args[0]
+            if target == "transpose":
+                d0, d1 = args[1], args[2]
+                perm = list(range(len(x.shape)))
+                perm[d0], perm[d1] = perm[d1], perm[d0]
+            else:
+                perm = list(args[1] if isinstance(args[1], (list, tuple))
+                            else args[1:])
+            return ff.transpose(x, perm, name=name)
+        if target in ("matmul", "bmm"):
+            return ff.batch_matmul(args[0], args[1], name=name)
+        if target == "mean":
+            axes = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            if axes is None:
+                axes = list(range(1, len(args[0].shape)))
+            axes = [axes] if isinstance(axes, int) else list(axes)
+            return ff.mean(args[0], axes,
+                           keepdims=kwargs.get("keepdim", False), name=name)
+        if target == "sum":
+            axes = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            if axes is None:
+                axes = list(range(1, len(args[0].shape)))
+            axes = [axes] if isinstance(axes, int) else list(axes)
+            return ff.reduce_sum(args[0], axes,
+                                 keepdims=kwargs.get("keepdim", False),
+                                 name=name)
+        if target == "dropout":
+            return ff.dropout(args[0], kwargs.get("p", 0.5), name=name)
+        if target == "getitem":
+            obj, idx = args[0], args[1]
+            if isinstance(obj, (tuple, list)):
+                return obj[idx]
+            # single-output op indexed as a tuple (e.g. nn.MultiheadAttention
+            # returns (out, weights); our op emits just the output). Index 0
+            # is the output; other indices (unused aux like attention
+            # weights) become None and fail loudly only if consumed.
+            if idx == 0:
+                return obj
+            return None
+        if target == "contiguous":
+            return args[0]
+        if target == "size":
+            raise NotImplementedError(
+                "dynamic .size() in traced graph — use static shapes")
+        raise NotImplementedError(f"fx target {target!r} has no translation")
+
+    # ---- weight transfer --------------------------------------------------
+    def copy_weights_to(self, ff: FFModel) -> int:
+        """Copy torch parameters into the compiled FFModel (transposing
+        Linear kernels torch [out,in] → ours [in,out]). Returns #tensors."""
+        torch = _torch()
+        nn = torch.nn
+        copied = 0
+        mods = dict(self.module.named_modules())
+        traced = torch.fx.symbolic_trace(self.module)
+        for node in traced.graph.nodes:
+            if node.op != "call_module":
+                continue
+            mod = mods[node.target]
+            name = node.name
+            try:
+                if isinstance(mod, nn.Linear):
+                    ff.set_parameter(name,
+                                     mod.weight.detach().numpy().T, "kernel")
+                    if mod.bias is not None:
+                        ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+                    copied += 1
+                elif isinstance(mod, nn.Conv2d):
+                    ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
+                    if mod.bias is not None:
+                        ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+                    copied += 1
+                elif isinstance(mod, nn.Embedding):
+                    ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
+                    copied += 1
+            except KeyError:
+                pass  # layer had no parameters in the compiled graph
+        return copied

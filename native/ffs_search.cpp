@@ -98,7 +98,11 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
         if (std::find(it->second.begin(), it->second.end(), c.name) !=
             it->second.end())
           kept.push_back(std::move(c));
-      if (!kept.empty()) cs = std::move(kept);
+      if (kept.empty())
+        throw std::runtime_error(
+            "substitution rule for " + n.type +
+            " allows no legal choice on this mesh (check choice names)");
+      cs = std::move(kept);
     }
     out.push_back(std::move(cs));
   }

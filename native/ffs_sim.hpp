@@ -126,7 +126,7 @@ class TaskgraphSimulator {
       }
       // ---- per-parameter gradient sync + optimizer update ----
       std::vector<int> sync_ids;
-      int last_bwd = bwd_id[0];
+      int last_bwd = N > 0 ? bwd_id[0] : -1;
       for (size_t i = 0; i < N; ++i) {
         const Choice& c = assign[i];
         if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {

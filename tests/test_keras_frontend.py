@@ -1,0 +1,127 @@
+"""Keras frontend (SURVEY §2.6): Sequential + functional Model + callbacks.
+
+Mirrors the reference's Keras examples
+(examples/python/keras/func_mnist_mlp.py, seq_cifar10_cnn.py style).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.keras import Model, Sequential
+from flexflow_tpu.keras.backend import to_categorical
+from flexflow_tpu.keras.callbacks import EarlyStopping, History
+from flexflow_tpu.keras.layers import (Activation, Add, Concatenate, Conv2D,
+                                       Dense, Dropout, Flatten, Input,
+                                       MaxPooling2D)
+from flexflow_tpu.keras.optimizers import SGD
+
+
+def blobs(n=512, d=16, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d) * 3
+    y = rs.randint(0, classes, n)
+    x = (centers[y] + rs.randn(n, d)).astype(np.float32)
+    return x, y.astype(np.int32).reshape(-1, 1)
+
+
+class TestSequential:
+    def test_mlp_trains(self):
+        x, y = blobs()
+        model = Sequential([
+            Input((16,)),
+            Dense(64, activation="relu"),
+            Dense(4, activation="softmax"),
+        ])
+        model.compile(optimizer=SGD(learning_rate=0.1),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], batch_size=64)
+        model.fit(x, y, epochs=4, verbose=False)
+        rep = model.evaluate(x, y, verbose=False)
+        assert rep["accuracy"] > 0.9
+
+    def test_cnn_runs(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 1, 12, 12).astype(np.float32)
+        y = rs.randint(0, 3, (32, 1)).astype(np.int32)
+        model = Sequential([
+            Input((1, 12, 12)),
+            Conv2D(4, 3, activation="relu"),
+            MaxPooling2D(2),
+            Flatten(),
+            Dense(3, activation="softmax"),
+        ])
+        model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], batch_size=16)
+        model.fit(x, y, epochs=1, verbose=False)
+        preds = model.predict(x)
+        assert preds.shape == (32, 3)
+
+    def test_categorical_loss(self):
+        x, y = blobs()
+        y1h = to_categorical(y, 4)
+        model = Sequential([Input((16,)), Dense(4, activation="softmax")])
+        model.compile(optimizer=SGD(0.1), loss="categorical_crossentropy",
+                      metrics=["accuracy"], batch_size=64)
+        model.fit(x, y1h, epochs=3, verbose=False)
+        rep = model.evaluate(x, y1h, verbose=False)
+        assert rep["accuracy"] > 0.8
+
+
+class TestFunctional:
+    def test_branches_and_merge(self):
+        x, y = blobs()
+        inp = Input((16,))
+        a = Dense(32, activation="relu")(inp)
+        b = Dense(32, activation="tanh")(inp)
+        h = Add()([a, b])
+        h2 = Concatenate(axis=-1)([h, a])
+        out = Dense(4, activation="softmax")(h2)
+        model = Model(inputs=inp, outputs=out)
+        model.compile(optimizer=SGD(0.1),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], batch_size=64)
+        model.fit(x, y, epochs=4, verbose=False)
+        rep = model.evaluate(x, y, verbose=False)
+        assert rep["accuracy"] > 0.9
+
+    def test_callbacks_early_stopping(self):
+        x, y = blobs()
+        model = Sequential([Input((16,)), Dense(4, activation="softmax")])
+        model.compile(optimizer=SGD(0.05),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], batch_size=64)
+        hist = History()
+        es = EarlyStopping(monitor="loss", patience=0, min_delta=10.0)
+        h = model.fit(x, y, epochs=10, callbacks=[hist, es], verbose=False)
+        # min_delta=10 means "never improves": first epoch sets best, second
+        # trips patience=0 -> exactly 2 epochs ran and loss was logged
+        assert len(hist.history["loss"]) == 2
+        assert len(h["loss"]) == 2
+
+    def test_predict_handles_remainder(self):
+        x, y = blobs(n=100)
+        model = Sequential([Input((16,)), Dense(4)])
+        model.compile(optimizer="sgd", loss="mse", batch_size=64)
+        out = model.predict(x)  # 100 = 64 + tail of 36
+        assert out.shape == (100, 4)
+
+    def test_get_set_weights(self):
+        x, y = blobs()
+        model = Sequential([Input((16,)), Dense(4, name="dense_out")])
+        model.compile(optimizer="sgd", loss="mse", batch_size=64)
+        layer = model.layers[-1]
+        (k, b) = layer.get_weights()
+        assert k.shape == (16, 4)
+        layer.set_weights([np.ones_like(k), np.zeros_like(b)])
+        out = model.predict(x[:64])
+        np.testing.assert_allclose(out[:, 0], x[:64].sum(axis=1), rtol=1e-4)
+
+
+class TestDatasets:
+    def test_mnist_shapes(self):
+        from flexflow_tpu.keras.datasets import mnist
+
+        (x_tr, y_tr), (x_te, y_te) = mnist.load_data()
+        assert x_tr.shape[1:] == (28, 28)
+        assert x_tr.dtype == np.uint8
+        assert len(x_tr) == len(y_tr)

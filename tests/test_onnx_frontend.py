@@ -1,0 +1,87 @@
+"""ONNX frontend translation table, driven by ModelProto-like stand-ins
+(the onnx package is absent in this environment — SURVEY §2.6)."""
+
+import types
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.onnx import ONNXModel
+
+
+def attr(name, **kw):
+    a = types.SimpleNamespace(name=name, i=None, f=None, s=None,
+                              ints=None, floats=None)
+    for k, v in kw.items():
+        setattr(a, k, v)
+    return a
+
+
+def onnx_node(op_type, inputs, outputs, *attrs):
+    return types.SimpleNamespace(op_type=op_type, input=list(inputs),
+                                 output=list(outputs), attribute=list(attrs))
+
+
+def fake_model(nodes):
+    graph = types.SimpleNamespace(node=nodes, initializer=[])
+    return types.SimpleNamespace(graph=graph)
+
+
+class TestONNXFrontend:
+    def test_mlp_graph(self):
+        nodes = [
+            onnx_node("Gemm", ["x"], ["h"], attr("out_dim", i=32)),
+            onnx_node("Relu", ["h"], ["h_act"]),
+            onnx_node("Gemm", ["h_act"], ["logits"], attr("out_dim", i=4)),
+            onnx_node("Softmax", ["logits"], ["probs"], attr("axis", i=-1)),
+        ]
+        ff = FFModel(FFConfig(batch_size=8, only_data_parallel=True))
+        t = ff.create_tensor((8, 16))
+        out = ONNXModel(fake_model(nodes)).apply(ff, {"x": t})
+        assert out.shape == (8, 4)
+        ff.compile(SGDOptimizer(lr=0.1),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        probs = ff.predict(x)
+        np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+
+    def test_conv_pool_residual(self):
+        nodes = [
+            onnx_node("Conv", ["x"], ["c1"], attr("out_channels", i=4),
+                      attr("kernel_shape", ints=[3, 3]),
+                      attr("strides", ints=[1, 1]),
+                      attr("pads", ints=[1, 1, 1, 1])),
+            onnx_node("Relu", ["c1"], ["r1"]),
+            onnx_node("Add", ["r1", "c1"], ["res"]),
+            onnx_node("MaxPool", ["res"], ["p1"],
+                      attr("kernel_shape", ints=[2, 2])),
+            onnx_node("Flatten", ["p1"], ["flat"]),
+            onnx_node("Gemm", ["flat"], ["out"], attr("out_dim", i=3)),
+        ]
+        ff = FFModel(FFConfig(batch_size=4, only_data_parallel=True))
+        t = ff.create_tensor((4, 1, 8, 8))
+        out = ONNXModel(fake_model(nodes)).apply(ff, {"x": t})
+        assert out.shape == (4, 3)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        x = np.random.RandomState(1).randn(4, 1, 8, 8).astype(np.float32)
+        assert ff.predict(x).shape == (4, 3)
+
+    def test_concat_split_transpose(self):
+        nodes = [
+            onnx_node("Split", ["x"], ["a", "b"], attr("axis", i=1),
+                      attr("split", ints=[8, 8])),
+            onnx_node("Concat", ["a", "b"], ["cat"], attr("axis", i=1)),
+            onnx_node("Transpose", ["cat"], ["tr"], attr("perm", ints=[0, 1])),
+            onnx_node("ReduceMean", ["tr"], ["m"], attr("axes", ints=[1]),
+                      attr("keepdims", i=0)),
+        ]
+        ff = FFModel(FFConfig(batch_size=4, only_data_parallel=True))
+        t = ff.create_tensor((4, 16))
+        out = ONNXModel(fake_model(nodes)).apply(ff, {"x": t})
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+        got = ff.predict(x)
+        np.testing.assert_allclose(got.reshape(-1), x.mean(axis=1),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,144 @@
+"""torch.fx frontend (SURVEY §2.6, python/flexflow/torch/model.py parity).
+
+Traces torch modules, translates to FFModel, checks numerics against the
+torch CPU forward (the reference's tests/align strategy)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.torch import PyTorchModel, torch_to_ff_file
+
+
+class SmallMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class BranchyNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(16, 32)
+        self.b = nn.Linear(16, 32)
+        self.out = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.out(torch.relu(self.a(x)) + torch.tanh(self.b(x)))
+
+
+class ScalarLeftNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return 1.0 - self.fc(x) * 0.5
+
+
+class AttnNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.attn = nn.MultiheadAttention(32, 4, batch_first=True)
+        self.fc = nn.Linear(32, 4)
+
+    def forward(self, x):
+        out, _ = self.attn(x, x, x)
+        return self.fc(out)
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(1, 4, 3)
+        self.pool = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(4 * 5 * 5, 3)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(torch.relu(self.conv(x)))))
+
+
+def build_ff(module, in_shape, batch=8):
+    ff = FFModel(FFConfig(batch_size=batch, only_data_parallel=True))
+    t = ff.create_tensor((batch,) + in_shape)
+    ptm = PyTorchModel(module)
+    out = ptm.torch_to_ff(ff, [t])
+    ff.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.MEAN_SQUARED_ERROR])
+    return ff, ptm, out
+
+
+class TestTorchFrontend:
+    def test_mlp_alignment(self):
+        m = SmallMLP().eval()
+        ff, ptm, _ = build_ff(m, (16,))
+        copied = ptm.copy_weights_to(ff)
+        assert copied == 2
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        ours = ff.predict(x)
+        theirs = m(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_branches_and_functions(self):
+        m = BranchyNet().eval()
+        ff, ptm, _ = build_ff(m, (16,))
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+        np.testing.assert_allclose(ff.predict(x),
+                                   m(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cnn_alignment(self):
+        m = SmallCNN().eval()
+        ff, ptm, _ = build_ff(m, (1, 12, 12))
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(2).randn(8, 1, 12, 12).astype(np.float32)
+        np.testing.assert_allclose(ff.predict(x),
+                                   m(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_scalar_on_left_sub(self):
+        # 1 - y must NOT translate to y - 1 (operand order regression)
+        m = ScalarLeftNet().eval()
+        ff, ptm, _ = build_ff(m, (16,))
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(4).randn(8, 16).astype(np.float32)
+        np.testing.assert_allclose(ff.predict(x),
+                                   m(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_multihead_attention_with_getitem(self):
+        # nn.MultiheadAttention returns a tuple; fx traces getitem[0]
+        m = AttnNet().eval()
+        ff, ptm, out = build_ff(m, (6, 32))
+        assert out.shape == (8, 6, 4)
+        x = np.random.RandomState(5).randn(8, 6, 32).astype(np.float32)
+        assert np.isfinite(ff.predict(x)).all()
+
+    def test_ff_file_roundtrip(self, tmp_path):
+        path = str(tmp_path / "model.ff")
+        torch_to_ff_file(SmallMLP(), path, {"x": (16,)})
+        ptm = PyTorchModel.from_file(path)  # no torch needed from here on
+        ff = FFModel(FFConfig(batch_size=8, only_data_parallel=True))
+        t = ff.create_tensor((8, 16))
+        ptm.torch_to_ff(ff, [t])
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        x = np.random.RandomState(3).randn(8, 16).astype(np.float32)
+        assert ff.predict(x).shape == (8, 4)
+
+    def test_training_through_traced_graph(self):
+        ff, ptm, _ = build_ff(SmallMLP(), (16,), batch=32)
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 16).astype(np.float32)
+        y = rs.randn(64, 4).astype(np.float32)
+        ff.fit(x, y, epochs=2, verbose=False)  # trains without error
