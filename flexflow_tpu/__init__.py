@@ -35,6 +35,8 @@ from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape, Tensor
 from flexflow_tpu.machine import MachineSpec, MachineView
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.optimizers import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.dataloader import DataLoaderSet, SingleDataLoader, create_data_loaders
+from flexflow_tpu.recompile import RecompileState
 from flexflow_tpu.initializers import (
     ConstantInitializer,
     GlorotUniformInitializer,
@@ -63,6 +65,10 @@ __all__ = [
     "FFModel",
     "AdamOptimizer",
     "SGDOptimizer",
+    "DataLoaderSet",
+    "SingleDataLoader",
+    "create_data_loaders",
+    "RecompileState",
     "ConstantInitializer",
     "GlorotUniformInitializer",
     "NormInitializer",

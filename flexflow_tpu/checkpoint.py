@@ -1,0 +1,136 @@
+"""Training-state checkpoint / resume.
+
+The reference has **no** model-state checkpointing (SURVEY §5.4 — only
+weight get/set and strategy files); this is deliberate new scope for the
+TPU framework: full (params, optimizer state, op state, iteration) capture
+to a single .npz plus a JSON manifest, restoring onto the live shardings.
+
+Format: flattened pytree with '/'-joined key paths. Works for any nesting
+of dict/list/tuple with array leaves, so SGD momentum and Adam (m, v, t)
+states round-trip unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+import jax
+
+
+def _flatten(tree, prefix="") -> List[Tuple[str, Any]]:
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            out += _flatten(tree[k], f"{prefix}{k}/")
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for i, v in enumerate(tree):
+            out += _flatten(v, f"{prefix}{i}/")
+        return out
+    return [(prefix[:-1], tree)]
+
+
+def _structure(tree):
+    """JSON-able skeleton used to rebuild nesting on load."""
+    if isinstance(tree, dict):
+        return {"__kind__": "dict",
+                "items": {k: _structure(v) for k, v in tree.items()}}
+    if isinstance(tree, tuple):
+        return {"__kind__": "tuple", "items": [_structure(v) for v in tree]}
+    if isinstance(tree, list):
+        return {"__kind__": "list", "items": [_structure(v) for v in tree]}
+    return {"__kind__": "leaf"}
+
+
+def _rebuild(skel, flat: Dict[str, Any], prefix=""):
+    kind = skel["__kind__"]
+    if kind == "dict":
+        return {k: _rebuild(v, flat, f"{prefix}{k}/")
+                for k, v in skel["items"].items()}
+    if kind in ("list", "tuple"):
+        seq = [_rebuild(v, flat, f"{prefix}{i}/")
+               for i, v in enumerate(skel["items"])]
+        return tuple(seq) if kind == "tuple" else seq
+    return flat[prefix[:-1]]
+
+
+def save_checkpoint(path: str, ffmodel) -> None:
+    """Write params + optimizer state + op state + iteration counter."""
+    state = {
+        "params": ffmodel.params,
+        "opt_state": ffmodel.opt_state,
+        "op_state": ffmodel.state,
+    }
+    flat = _flatten(state)
+    arrays = {}
+    scalars = {}
+    for k, v in flat:
+        if hasattr(v, "shape"):
+            arrays[k] = np.asarray(v)
+        else:
+            scalars[k] = v
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+    manifest = {
+        "version": 1,
+        "iteration": ffmodel._iter,
+        "structure": _structure(state),
+        "scalars": scalars,
+        "array_keys": sorted(arrays),
+    }
+    with open(_manifest_path(path), "w") as f:
+        json.dump(manifest, f)
+
+
+def _manifest_path(path: str) -> str:
+    base = path[:-4] if path.endswith(".npz") else path
+    return base + ".manifest.json"
+
+
+def load_checkpoint(path: str, ffmodel) -> int:
+    """Restore state saved by save_checkpoint onto the live shardings.
+
+    Returns the saved iteration counter. Shapes must match the compiled
+    model (same graph); shardings may differ — arrays are re-placed with
+    the current strategy's NamedShardings.
+    """
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    with open(_manifest_path(path)) as f:
+        manifest = json.load(f)
+    data = np.load(npz_path)
+    flat = {k: data[k] for k in manifest["array_keys"]}
+    flat.update(manifest["scalars"])
+    state = _rebuild(manifest["structure"], flat)
+
+    # re-place arrays on the shardings of the live values
+    def place(live, new):
+        if isinstance(live, dict):
+            if not isinstance(new, dict) or set(new) != set(live):
+                raise ValueError(
+                    f"checkpoint structure mismatch: expected keys "
+                    f"{sorted(live)}, found "
+                    f"{sorted(new) if isinstance(new, dict) else type(new)}")
+            return {k: place(live[k], new[k]) for k in live}
+        if isinstance(live, (list, tuple)):
+            if not isinstance(new, (list, tuple)) or len(new) != len(live):
+                raise ValueError(
+                    f"checkpoint structure mismatch: expected sequence of "
+                    f"{len(live)}, found {new!r:.80}")
+            rebuilt = [place(l, n) for l, n in zip(live, new)]
+            return type(live)(rebuilt) if isinstance(live, tuple) else rebuilt
+        if hasattr(live, "sharding") and hasattr(new, "shape"):
+            if tuple(live.shape) != tuple(np.shape(new)):
+                raise ValueError(
+                    f"checkpoint shape {np.shape(new)} != live {live.shape}")
+            return jax.device_put(new, live.sharding)
+        return new
+
+    ffmodel.params = place(ffmodel.params, state["params"])
+    ffmodel.opt_state = place(ffmodel.opt_state, state["opt_state"])
+    ffmodel.state = place(ffmodel.state, state["op_state"])
+    ffmodel._iter = int(manifest["iteration"])
+    return ffmodel._iter

@@ -1,0 +1,112 @@
+"""Data loading: staged dataset + per-iteration sharded batches.
+
+TPU re-design of the reference's SingleDataLoader
+(python/flexflow_dataloader.{h,cc,cu}, flexflow_cffi.py:2433): the
+reference stages the entire dataset into zero-copy host memory once, then
+per iteration an index-task copies each shard's batch slice to GPU
+framebuffer. Here the dataset is staged once as a device array sharded
+over the data axis (HBM-resident when it fits, host-resident otherwise),
+and ``next_batch`` slices the staged array on device — no host→device
+traffic in steady state, which is exactly the role the reference's
+PY_DL_*_LOAD_BATCH_GPU tasks play.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class SingleDataLoader:
+    """One input (or label) tensor's loader.
+
+    ``num_samples`` must be a multiple of the batch size for the staged
+    path (the reference truncates the same way).
+    """
+
+    def __init__(self, ffmodel, input_name: Optional[str], full_array,
+                 batch_size: Optional[int] = None, stage_on_device: bool = True):
+        self.ff = ffmodel
+        self.input_name = input_name  # None => label loader
+        arr = np.asarray(full_array)
+        bs = batch_size or ffmodel.input_tensors[0].shape[0]
+        self.batch_size = bs
+        self.num_samples = (arr.shape[0] // bs) * bs
+        if self.num_samples == 0:
+            raise ValueError(
+                f"dataset of {arr.shape[0]} samples < batch size {bs}")
+        arr = arr[: self.num_samples]
+        self.num_batches = self.num_samples // bs
+        sharding = ffmodel.executor.batch_sharding()
+        if stage_on_device:
+            self.data = jax.device_put(jnp.asarray(arr), sharding)
+        else:
+            self.data = arr
+        self._sharding = sharding
+        self.next_index = 0
+
+    def reset(self) -> None:
+        self.next_index = 0
+
+    def next_batch(self, _ff=None):
+        """Return the next batch, wrapping around (reference semantics:
+        the C++ loader reloads from the start each epoch)."""
+        if self.next_index + self.batch_size > self.num_samples:
+            self.next_index = 0
+        start = self.next_index
+        self.next_index += self.batch_size
+        if isinstance(self.data, np.ndarray):
+            # single transfer straight onto the batch sharding
+            return jax.device_put(self.data[start:start + self.batch_size],
+                                  self._sharding)
+        return jax.lax.dynamic_slice_in_dim(self.data, start, self.batch_size,
+                                            axis=0)
+
+
+class DataLoaderSet:
+    """All input + label loaders for a model; drives fit-style loops
+    (the reference's ``dataloaders.next_batch`` list in fit,
+    flexflow_cffi.py:2080)."""
+
+    def __init__(self, ffmodel, xs: Sequence, y, batch_size: Optional[int] = None,
+                 stage_on_device: bool = True):
+        names = ffmodel.executor.input_names
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        if len(xs) != len(names):
+            raise ValueError(f"model has {len(names)} inputs, got {len(xs)}")
+        self.input_loaders = [
+            SingleDataLoader(ffmodel, n, x, batch_size, stage_on_device)
+            for n, x in zip(names, xs)
+        ]
+        self.label_loader = SingleDataLoader(ffmodel, None, y, batch_size,
+                                             stage_on_device)
+        counts = {l.num_samples for l in self.input_loaders + [self.label_loader]}
+        if len(counts) != 1:
+            raise ValueError(
+                f"input/label loaders disagree on usable sample count "
+                f"{sorted(counts)} — all arrays must have the same length")
+        self.ff = ffmodel
+
+    @property
+    def num_batches(self) -> int:
+        return self.input_loaders[0].num_batches
+
+    def reset(self) -> None:
+        for l in self.input_loaders:
+            l.reset()
+        self.label_loader.reset()
+
+    def next_batch(self):
+        inputs = {l.input_name: l.next_batch() for l in self.input_loaders}
+        labels = self.label_loader.next_batch()
+        return inputs, labels
+
+
+def create_data_loaders(ffmodel, x, y, batch_size: Optional[int] = None,
+                        stage_on_device: bool = True) -> DataLoaderSet:
+    """Sugar matching ffmodel.create_data_loader (flexflow_cffi.py:2178)."""
+    return DataLoaderSet(ffmodel, x, y, batch_size, stage_on_device)

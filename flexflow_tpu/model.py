@@ -475,6 +475,12 @@ class FFModel:
             _unity.export_strategy_file(cfg.export_strategy_file, axes_now,
                                         self.strategy, nodes)
         apply_strategy(nodes, self.strategy, self.mesh)
+        if cfg.export_strategy_computation_graph_file:
+            from flexflow_tpu.utils.dot import export_strategy_dot
+            export_strategy_dot(nodes, self.mesh,
+                                cfg.export_strategy_computation_graph_file,
+                                include_costs=cfg.include_costs_dot_graph,
+                                search_info=self.search_info)
 
         compute_dtype = (
             jnp.bfloat16 if (cfg.allow_mixed_precision and
@@ -546,6 +552,54 @@ class FFModel:
         if verbose:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
         return thr
+
+    def fit_loader(self, loaders, epochs: Optional[int] = None,
+                   verbose: bool = True):
+        """Steady-state training from staged on-device loaders
+        (flexflow_tpu.dataloader) — no host→device traffic per step."""
+        epochs = epochs or self.config.epochs
+        train_step = self.executor.make_train_step()
+        bs = loaders.input_loaders[0].batch_size
+        start = time.time()
+        loss = None
+        for epoch in range(epochs):
+            loaders.reset()
+            self._metrics_acc = PerfMetrics()
+            mtotals = None
+            for _ in range(loaders.num_batches):
+                inputs, labels = loaders.next_batch()
+                self._rng, sub = jax.random.split(self._rng)
+                (self.params, self.opt_state, self.state, loss, mvals) = train_step(
+                    self.params, self.opt_state, self.state, inputs, labels, sub)
+                self._iter += 1
+                mtotals = mvals if mtotals is None else jax.tree.map(
+                    jnp.add, mtotals, mvals)
+            self._metrics_acc.update(dict(mtotals or {}), bs * loaders.num_batches)
+            if verbose:
+                rep = self._metrics_acc.report()
+                print(f"epoch {epoch}: loss={float(loss):.4f} " +
+                      " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
+        if loss is not None:
+            self._last_loss = float(loss)
+        elapsed = time.time() - start
+        n = loaders.num_batches * loaders.input_loaders[0].batch_size * epochs
+        thr = n / elapsed
+        if verbose:
+            print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
+        return thr
+
+    # ---- checkpoint / resume (new scope vs reference — SURVEY §5.4) -------
+    def save_checkpoint(self, path: str) -> None:
+        from flexflow_tpu.checkpoint import save_checkpoint
+        save_checkpoint(path, self)
+
+    def load_checkpoint(self, path: str) -> int:
+        from flexflow_tpu.checkpoint import load_checkpoint
+        return load_checkpoint(path, self)
+
+    def recompile_on_condition(self, recompile_state) -> bool:
+        from flexflow_tpu.recompile import recompile_on_condition
+        return recompile_on_condition(self, recompile_state)
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
         xs = x if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,70 @@
+"""Dynamic recompilation: mutate the model mid-training on a trigger.
+
+Analog of the reference's RecompileState (include/flexflow/recompile.h:26)
+and FFModel::recompile_on_condition (src/runtime/model.cc:2422-2426), used
+there for MoE expert-capacity adaptation (examples/cpp/mixture_of_experts/
+moe.cc:65-83). Under XLA "recompile" means: alter layer properties, rerun
+``compile()`` (a fresh jitted step with new static shapes), and carry the
+old parameters over where names+shapes still match.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    """trigger_func() -> bool decides; alter_func(ff) mutates layer
+    properties; both run between iterations (recompile.h:26 semantics)."""
+
+    def __init__(self, trigger_func: Callable[[], bool],
+                 alter_func: Callable[..., None], ffmodel=None):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func())
+
+    def alter(self) -> None:
+        self.alter_func(self.ffmodel)
+        self.recompilations += 1
+
+
+def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
+    """If the trigger fires: snapshot params, alter, re-compile, restore
+    matching params. Returns True when a recompile happened."""
+    if not state.trigger():
+        return False
+    old_params = ffmodel.params
+    optimizer = ffmodel.optimizer
+    loss_type = ffmodel.loss_type
+    metric_types = list(ffmodel.metrics.metrics)
+    state.ffmodel = ffmodel
+    state.alter()
+    # re-derive tensor shapes through the altered layer list (alter_func
+    # may have changed properties that move downstream shapes)
+    from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.ops import OpRegistry
+
+    for layer in ffmodel.layers:
+        if layer.op_type == OperatorType.INPUT:
+            continue
+        op = OpRegistry.create(layer, [t.shape for t in layer.inputs])
+        for t, s in zip(layer.outputs, op.output_shapes):
+            t.shape = tuple(s)
+    iters_so_far = ffmodel._iter
+    ffmodel.compile(optimizer, loss_type, metric_types, mesh=None)
+    ffmodel._iter = iters_so_far  # compile() zeroes it; training continues
+    # carry over parameters whose (name, shape) survived the alteration
+    import numpy as np
+
+    for lname, sub in old_params.items():
+        if lname not in ffmodel.params:
+            continue
+        for pname, arr in sub.items():
+            live = ffmodel.params[lname].get(pname)
+            if live is not None and tuple(live.shape) == tuple(arr.shape):
+                ffmodel.set_parameter(lname, np.asarray(arr), pname)
+    return True

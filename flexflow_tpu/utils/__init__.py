@@ -1,0 +1,1 @@
+"""Utility subsystems: dot export, search tracing, profiling."""

@@ -1,0 +1,52 @@
+"""Graphviz export of the PCG + chosen strategy.
+
+Analog of the reference's DotFile/RecordFormatter utilities
+(include/flexflow/utils/dot/, src/utils/dot/record_formatter.cc) and
+Graph::export_strategy_computation_graph (include/flexflow/graph.h:339),
+wired to --export-strategy-computation-graph / --include-costs-dot-graph
+(config.h:143-145).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _fmt_spec(spec) -> str:
+    if spec is None:
+        return "rep"
+    entries = [str(e) if e is not None else "." for e in spec]
+    return "[" + ",".join(entries) + "]" if entries else "rep"
+
+
+def export_strategy_dot(nodes, mesh, path: str,
+                        include_costs: bool = False,
+                        search_info: Optional[dict] = None) -> None:
+    """Write a .dot file: one record node per op showing name, type,
+    output shape, and the sharding decision."""
+    lines = ["digraph pcg {", '  rankdir="TB";',
+             '  node [shape=record, fontsize=10];']
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh else {}
+    lines.append(f'  label="mesh: {axes}";')
+    guids = {n.op.guid for n in nodes}
+    for node in nodes:
+        op = node.op
+        spec = node.output_specs[0] if node.output_specs else None
+        cost = ""
+        if include_costs:
+            cost = f"|flops {op.flops():.3g}"
+        label = (f"{{{op.name}|{op.op_type.name}|"
+                 f"out {tuple(op.output_shapes[0])}|"
+                 f"spec {_fmt_spec(spec)}{cost}}}")
+        lines.append(f'  n{op.guid} [label="{label}"];')
+        for ref in node.input_refs:
+            if ref[0] == "op" and ref[1] in guids:
+                lines.append(f"  n{ref[1]} -> n{op.guid};")
+    if search_info:
+        t = search_info.get("predicted_time")
+        if t:
+            lines.append(
+                f'  info [shape=note, label="predicted {t * 1e3:.3f} ms"];')
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")

@@ -1,0 +1,41 @@
+"""RecursiveLogger: depth-indented search/trace logging.
+
+Analog of include/flexflow/utils/recursive_logger.h:10-27 — the reference
+tags each line with its recursion depth ("[depth] message") so nested
+search decisions read as a tree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Optional, TextIO
+
+
+class RecursiveLogger:
+    def __init__(self, name: str = "search", stream: Optional[TextIO] = None,
+                 enabled: bool = True):
+        self.name = name
+        self.stream = stream or sys.stderr
+        self.enabled = enabled
+        self.depth = 0
+
+    @contextlib.contextmanager
+    def enter(self, tag: str = ""):
+        """Nested scope: lines inside are indented one level deeper
+        (reference's TAG_ENTER/LEAVE)."""
+        if tag:
+            self.info(tag)
+        self.depth += 1
+        try:
+            yield self
+        finally:
+            self.depth -= 1
+
+    def info(self, msg: str) -> None:
+        if self.enabled:
+            self.stream.write(f"[{self.name}] [{self.depth}] "
+                              + "  " * self.depth + msg + "\n")
+
+    def spew(self, msg: str) -> None:  # reference's finer level
+        self.info(msg)
