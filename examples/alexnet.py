@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""AlexNet example (reference examples/cpp/AlexNet)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu.models import create_alexnet
+
+
+def main():
+    cfg = parse_config()
+    ff = create_alexnet(cfg.batch_size, ff_config=cfg)
+    shape = ff.input_tensors[0].shape[1:]
+    train_synthetic(ff, cfg, [(shape, "float32", 0)], (1,), classes=10)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""CANDLE-Uno example (reference examples/cpp/candle_uno)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu import LossType, MetricsType
+from flexflow_tpu.models import CandleUnoConfig, create_candle_uno
+
+
+def main():
+    cfg = parse_config()
+    cc = CandleUnoConfig(batch_size=cfg.batch_size)
+    ff = create_candle_uno(cc, cfg)
+    specs = [((d,), "float32", 0) for d in cc.input_features.values()]
+    train_synthetic(ff, cfg, specs, (1,),
+                    loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                    metrics=(MetricsType.MEAN_SQUARED_ERROR,))
+
+
+if __name__ == "__main__":
+    main()

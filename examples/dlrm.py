@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""DLRM example (reference examples/cpp/DLRM): parameter-parallel
+embedding tables + bottom/top MLPs."""
+
+import numpy as np
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import DLRMConfig, create_dlrm
+
+
+def main():
+    cfg = parse_config()
+    dc = DLRMConfig(batch_size=cfg.batch_size)
+    ff = create_dlrm(dc, cfg)
+    specs = [((dc.indices_per_feature,), "int32", dc.vocab_size)
+             for _ in range(dc.num_sparse_features)]
+    specs.append(((dc.dense_dim,), "float32", 0))
+    train_synthetic(ff, cfg, specs, (1,),
+                    loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                    metrics=(MetricsType.MEAN_SQUARED_ERROR,),
+                    optimizer=SGDOptimizer(lr=0.01))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Inception-v3 example (reference examples/cpp/InceptionV3)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu.models import InceptionConfig, create_inception_v3
+
+
+def main():
+    cfg = parse_config()
+    ic = InceptionConfig(batch_size=cfg.batch_size)
+    ff = create_inception_v3(ic, cfg)
+    train_synthetic(ff, cfg, [((3, ic.image_size, ic.image_size), "float32", 0)],
+                    (1,), classes=ic.num_classes)
+
+
+if __name__ == "__main__":
+    main()

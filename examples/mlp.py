@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""MLP example (reference examples/cpp/MLP_Unify): deep wide MLP —
+the column/row-parallel showcase."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu import LossType, MetricsType
+from flexflow_tpu.models import create_mlp
+
+
+def main():
+    cfg = parse_config()
+    hidden = [4096, 4096, 4096, 4096]
+    ff = create_mlp(cfg.batch_size, 1024, hidden, 10, ff_config=cfg)
+    train_synthetic(ff, cfg, [((1024,), "float32", 0)], (1,), classes=10)
+
+
+if __name__ == "__main__":
+    main()

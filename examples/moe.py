@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Mixture-of-Experts example (reference examples/cpp/mixture_of_experts)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu import AdamOptimizer
+from flexflow_tpu.models import MoEConfig, create_moe
+
+
+def main():
+    cfg = parse_config()
+    mc = MoEConfig(batch_size=cfg.batch_size)
+    ff = create_moe(mc, cfg)
+    train_synthetic(ff, cfg, [((mc.input_dim,), "float32", 0)], (1,),
+                    classes=mc.num_classes,
+                    optimizer=AdamOptimizer(alpha=1e-3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""ResNet-50 example (reference examples/cpp/ResNet)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu.models import ResNetConfig, create_resnet
+
+
+def main():
+    cfg = parse_config()
+    rc = ResNetConfig(batch_size=cfg.batch_size)
+    ff = create_resnet(rc, cfg)
+    train_synthetic(ff, cfg, [((3, rc.image_size, rc.image_size), "float32", 0)],
+                    (1,), classes=rc.num_classes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""ResNeXt-50 example (reference examples/cpp/resnext50)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu.models import ResNeXtConfig, create_resnext50
+
+
+def main():
+    cfg = parse_config()
+    rc = ResNeXtConfig(
+        batch_size=cfg.batch_size if cfg.batch_size_explicit else 16)
+    cfg.batch_size = rc.batch_size
+    ff = create_resnext50(rc, cfg)
+    train_synthetic(ff, cfg, [((3, rc.image_size, rc.image_size), "float32", 0)],
+                    (1,), classes=rc.num_classes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""BERT-proxy Transformer example (reference examples/cpp/Transformer).
+
+Reference config: 12 layers, hidden 1024, 16 heads, seq 512, batch 8
+(transformer.cc:79-84). Usage:
+    python examples/transformer.py --budget 30 [-b 8] [--epochs 1]
+    python examples/transformer.py --only-data-parallel
+"""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu import AdamOptimizer, LossType, MetricsType
+from flexflow_tpu.models import TransformerConfig, create_transformer
+
+
+def main():
+    cfg = parse_config()
+    tc = TransformerConfig(
+        batch_size=cfg.batch_size if cfg.batch_size_explicit else 8)
+    cfg.batch_size = tc.batch_size
+    ff = create_transformer(tc, cfg)
+    train_synthetic(
+        ff, cfg,
+        [((tc.seq_length, tc.hidden_size), "float32", 0)],
+        (tc.seq_length, 1),
+        loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=(MetricsType.MEAN_SQUARED_ERROR,),
+        optimizer=AdamOptimizer(alpha=1e-4),
+    )
+
+
+if __name__ == "__main__":
+    main()

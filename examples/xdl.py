@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""XDL example (reference examples/cpp/XDL)."""
+
+from common import parse_config, train_synthetic
+
+from flexflow_tpu.models import XDLConfig, create_xdl
+
+
+def main():
+    cfg = parse_config()
+    xc = XDLConfig(batch_size=cfg.batch_size)
+    ff = create_xdl(xc, cfg)
+    specs = [((xc.embedding_bag_size,), "int32", v) for v in xc.embedding_size]
+    train_synthetic(ff, cfg, specs, (1,), classes=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ class FFConfig:
     # training flags (-e/-b/--learning-rate/...)
     epochs: int = 1
     batch_size: int = 64
+    batch_size_explicit: bool = False  # True once -b/--batch-size is parsed
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     iterations: int = 1
@@ -87,6 +88,7 @@ class FFConfig:
                 self.epochs = int(take())
             elif a in ("-b", "--batch-size"):
                 self.batch_size = int(take())
+                self.batch_size_explicit = True
             elif a == "--learning-rate":
                 self.learning_rate = float(take())
             elif a == "--weight-decay":

@@ -174,7 +174,8 @@ class GraphExecutor:
         def train_step(params, opt_state, state, inputs, labels, rng):
             def loss_fn(p):
                 ctx = OpContext(training=True, rng=rng,
-                                compute_dtype=self.compute_dtype)
+                                compute_dtype=self.compute_dtype,
+                                mesh=self.mesh)
                 values, new_state, aux = self.run_graph(p, state, inputs, ctx)
                 logits = values[(self.final_guid, 0)]
                 loss = self._loss_value(logits, labels)
@@ -200,7 +201,8 @@ class GraphExecutor:
             return self._jit_eval
 
         def eval_step(params, state, inputs, labels):
-            ctx = OpContext(training=False, compute_dtype=self.compute_dtype)
+            ctx = OpContext(training=False, compute_dtype=self.compute_dtype,
+                            mesh=self.mesh)
             values, _, _ = self.run_graph(params, state, inputs, ctx)
             logits = values[(self.final_guid, 0)]
             loss = self._loss_value(logits, labels)
@@ -215,7 +217,7 @@ class GraphExecutor:
 
         def fwd(params, state, inputs, rng):
             ctx = OpContext(training=training, rng=rng,
-                            compute_dtype=self.compute_dtype)
+                            compute_dtype=self.compute_dtype, mesh=self.mesh)
             values, new_state, _ = self.run_graph(params, state, inputs, ctx)
             return values[(self.final_guid, 0)], new_state
 

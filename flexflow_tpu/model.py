@@ -167,12 +167,15 @@ class FFModel:
                             vdim: int = 0, dropout: float = 0.0, bias: bool = True,
                             add_bias_kv: bool = False, add_zero_attn: bool = False,
                             causal: bool = False, kernel_initializer=None,
+                            seq_parallel: Optional[str] = None,
                             name: Optional[str] = None) -> Tensor:
+        """``seq_parallel='seq'`` runs the attention core as ring attention
+        over that mesh axis (context parallelism for long sequences)."""
         layer = self._add_layer(OperatorType.MULTIHEAD_ATTENTION,
                                 [query, key, value], dict(
             embed_dim=embed_dim, num_heads=num_heads, kdim=kdim or embed_dim,
             vdim=vdim or embed_dim, dropout=dropout, bias=bias, causal=causal,
-            kernel_initializer=kernel_initializer), name)
+            kernel_initializer=kernel_initializer, seq_parallel=seq_parallel), name)
         return self._finish(layer)
 
     # ---- elementwise -------------------------------------------------------
@@ -512,81 +515,68 @@ class FFModel:
         return {n: self._shard_batch(x) for n, x in zip(names, xs)}
 
     # ======================= train / eval loops ============================
-    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
-            epochs: Optional[int] = None, verbose: bool = True):
-        """Keras-style whole-dataset training loop
-        (base_model.py:376-430 / flexflow_cffi.py:2073-2086)."""
-        cfg = self.config
-        epochs = epochs or cfg.epochs
-        xs = x if isinstance(x, (list, tuple)) else [x]
-        n = xs[0].shape[0]
-        bs = batch_size or self.input_tensors[0].shape[0]
+    def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
+                    verbose: bool, on_epoch_start=None) -> float:
+        """Shared epoch loop: per-batch jitted step, on-device metric
+        accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
+        report. ``next_batch(epoch, b)`` -> (inputs dict, labels)."""
         train_step = self.executor.make_train_step()
-        num_batches = n // bs
-        if num_batches == 0:
-            raise ValueError(
-                f"dataset of {n} samples is smaller than batch size {bs}")
         start = time.time()
+        loss = None
         for epoch in range(epochs):
+            if on_epoch_start is not None:
+                on_epoch_start()
             self._metrics_acc = PerfMetrics()
-            mtotals = None  # on-device metric sums; host sync once per epoch
+            mtotals = None
             for b in range(num_batches):
-                sl = slice(b * bs, (b + 1) * bs)
-                inputs = self._stage_inputs([xx[sl] for xx in xs])
-                labels = self._shard_batch(y[sl])
+                inputs, labels = next_batch(epoch, b)
                 self._rng, sub = jax.random.split(self._rng)
                 (self.params, self.opt_state, self.state, loss, mvals) = train_step(
                     self.params, self.opt_state, self.state, inputs, labels, sub)
                 self._iter += 1
                 mtotals = mvals if mtotals is None else jax.tree.map(
                     jnp.add, mtotals, mvals)
-            self._metrics_acc.update(
-                {k: v for k, v in (mtotals or {}).items()}, bs * num_batches)
+            self._metrics_acc.update(dict(mtotals or {}), bs * num_batches)
             self._last_loss = float(loss)
             if verbose:
                 rep = self._metrics_acc.report()
-                print(f"epoch {epoch}: loss={float(loss):.4f} " +
+                print(f"epoch {epoch}: loss={self._last_loss:.4f} " +
                       " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
         elapsed = time.time() - start
-        thr = bs * num_batches * epochs / elapsed  # only samples actually trained
+        thr = bs * num_batches * epochs / elapsed
         if verbose:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
         return thr
+
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, verbose: bool = True):
+        """Keras-style whole-dataset training loop, streaming batches from
+        host (base_model.py:376-430 / flexflow_cffi.py:2073-2086)."""
+        epochs = epochs or self.config.epochs
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        n = xs[0].shape[0]
+        bs = batch_size or self.input_tensors[0].shape[0]
+        num_batches = n // bs
+        if num_batches == 0:
+            raise ValueError(
+                f"dataset of {n} samples is smaller than batch size {bs}")
+
+        def next_batch(epoch, b):
+            sl = slice(b * bs, (b + 1) * bs)
+            return (self._stage_inputs([xx[sl] for xx in xs]),
+                    self._shard_batch(y[sl]))
+
+        return self._run_epochs(next_batch, num_batches, bs, epochs, verbose)
 
     def fit_loader(self, loaders, epochs: Optional[int] = None,
                    verbose: bool = True):
         """Steady-state training from staged on-device loaders
         (flexflow_tpu.dataloader) — no host→device traffic per step."""
         epochs = epochs or self.config.epochs
-        train_step = self.executor.make_train_step()
         bs = loaders.input_loaders[0].batch_size
-        start = time.time()
-        loss = None
-        for epoch in range(epochs):
-            loaders.reset()
-            self._metrics_acc = PerfMetrics()
-            mtotals = None
-            for _ in range(loaders.num_batches):
-                inputs, labels = loaders.next_batch()
-                self._rng, sub = jax.random.split(self._rng)
-                (self.params, self.opt_state, self.state, loss, mvals) = train_step(
-                    self.params, self.opt_state, self.state, inputs, labels, sub)
-                self._iter += 1
-                mtotals = mvals if mtotals is None else jax.tree.map(
-                    jnp.add, mtotals, mvals)
-            self._metrics_acc.update(dict(mtotals or {}), bs * loaders.num_batches)
-            if verbose:
-                rep = self._metrics_acc.report()
-                print(f"epoch {epoch}: loss={float(loss):.4f} " +
-                      " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
-        if loss is not None:
-            self._last_loss = float(loss)
-        elapsed = time.time() - start
-        n = loaders.num_batches * loaders.input_loaders[0].batch_size * epochs
-        thr = n / elapsed
-        if verbose:
-            print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
-        return thr
+        return self._run_epochs(lambda e, b: loaders.next_batch(),
+                                loaders.num_batches, bs, epochs, verbose,
+                                on_epoch_start=loaders.reset)
 
     # ---- checkpoint / resume (new scope vs reference — SURVEY §5.4) -------
     def save_checkpoint(self, path: str) -> None:

@@ -1,0 +1,65 @@
+"""Mixture-of-Experts model (examples/cpp/mixture_of_experts/moe.cc).
+
+Reference default (moe.cc:137-163): flattened input -> moe layer
+(num_exp experts, top-k select, load-balance loss) -> softmax head; the
+encoder variant stacks attention + MoE blocks (create_moe_encoder,
+moe.cc:100-127). Dynamic expert rebalance via recompile_on_condition is
+exercised in tests/test_aux_subsystems-style flows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    batch_size: int = 32
+    input_dim: int = 784  # reference uses MNIST-shaped input
+    num_classes: int = 10
+    num_exp: int = 4
+    num_select: int = 2
+    hidden_size: int = 64
+    alpha: float = 2.0      # group_by capacity factor
+    lambda_bal: float = 0.04  # load-balance loss weight
+    # encoder variant
+    num_encoder_layers: int = 0
+    seq_length: int = 16
+    num_attention_heads: int = 4
+
+
+def create_moe(cfg: MoEConfig, ff_config: FFConfig = None) -> FFModel:
+    """Flat MoE classifier (moe.cc:159-167)."""
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    t = ff.create_tensor((cfg.batch_size, cfg.input_dim), name="input")
+    t = ff.moe(t, cfg.num_exp, cfg.num_select, cfg.hidden_size,
+               cfg.alpha, cfg.lambda_bal, name="moe")
+    t = ff.dense(t, cfg.num_classes, name="head")
+    t = ff.softmax(t)
+    return ff
+
+
+def create_moe_encoder(cfg: MoEConfig, ff_config: FFConfig = None) -> FFModel:
+    """Attention + MoE encoder stack (create_moe_encoder, moe.cc:100-127):
+    each block is LN(x + attention(x)) then LN(x + moe(x))."""
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    x = ff.create_tensor((cfg.batch_size, cfg.seq_length, cfg.hidden_size),
+                         name="input")
+    for i in range(max(cfg.num_encoder_layers, 1)):
+        a = ff.multihead_attention(x, x, x, cfg.hidden_size,
+                                   cfg.num_attention_heads, name=f"attn_{i}")
+        x = ff.layer_norm(ff.add(x, a, name=f"res1_{i}"), name=f"ln1_{i}")
+        # token-level MoE: flatten tokens into the sample dim
+        b, s, h = x.shape
+        flat = ff.reshape(x, (b * s, h), name=f"flatten_{i}")
+        m = ff.moe(flat, cfg.num_exp, cfg.num_select, cfg.hidden_size,
+                   cfg.alpha, cfg.lambda_bal, name=f"moe_{i}")
+        m = ff.reshape(m, (b, s, h), name=f"unflatten_{i}")
+        x = ff.layer_norm(ff.add(x, m, name=f"res2_{i}"), name=f"ln2_{i}")
+    x = ff.dense(x, cfg.num_classes, name="head")
+    x = ff.softmax(x)
+    return ff

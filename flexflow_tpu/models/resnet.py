@@ -1,0 +1,60 @@
+"""ResNet-50 (examples/cpp/ResNet/resnet.cc).
+
+Bottleneck: 1x1 conv -> 3x3 (stride) -> 1x1 to 4x expansion, projection
+shortcut on stride/width change, ReLU join (resnet.cc:39-58); stem
+7x7/s2 + 3x3 maxpool; stages [3,4,6,3]; avgpool -> flat -> dense(10)
+(resnet.cc:91-112 — the reference's CIFAR-style 10-way head).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode, PoolType
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    batch_size: int = 64
+    image_size: int = 224
+    num_classes: int = 10  # reference uses 10 (resnet.cc:112)
+    stages: tuple = (3, 4, 6, 3)
+
+
+def _bottleneck(ff: FFModel, t, out_channels: int, stride: int, name: str):
+    inp = t
+    t = ff.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c1")
+    t = ff.relu(t)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1, name=f"{name}_c2")
+    t = ff.relu(t)
+    t = ff.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c3")
+    if stride > 1 or inp.shape[1] != 4 * out_channels:
+        # projection shortcut has no activation (resnet.cc:53, AC_MODE_NONE)
+        inp = ff.conv2d(inp, 4 * out_channels, 1, 1, stride, stride, 0, 0,
+                        name=f"{name}_proj")
+    t = ff.add(t, inp, name=f"{name}_add")
+    return ff.relu(t, inplace=False)
+
+
+def create_resnet(cfg: ResNetConfig, ff_config: FFConfig = None) -> FFModel:
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    t = ff.create_tensor((cfg.batch_size, 3, cfg.image_size, cfg.image_size),
+                         name="input")
+    t = ff.conv2d(t, 64, 7, 7, 2, 2, 3, 3, name="stem")
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
+    for i in range(cfg.stages[0]):
+        t = _bottleneck(ff, t, 64, 1, f"s1_b{i}")
+    for i in range(cfg.stages[1]):
+        t = _bottleneck(ff, t, 128, 2 if i == 0 else 1, f"s2_b{i}")
+    for i in range(cfg.stages[2]):
+        t = _bottleneck(ff, t, 256, 2 if i == 0 else 1, f"s3_b{i}")
+    for i in range(cfg.stages[3]):
+        t = _bottleneck(ff, t, 512, 2 if i == 0 else 1, f"s4_b{i}")
+    t = ff.pool2d(t, t.shape[2], t.shape[3], 1, 1, 0, 0,
+                  pool_type=PoolType.POOL_AVG)
+    t = ff.flat(t)
+    t = ff.dense(t, cfg.num_classes, name="fc")
+    t = ff.softmax(t)
+    return ff

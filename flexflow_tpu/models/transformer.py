@@ -29,6 +29,7 @@ class TransformerConfig:
     dropout: float = 0.0
     layer_norm: bool = True  # False = exact reference block structure
     causal: bool = False
+    seq_parallel: str = None  # mesh axis for ring attention (e.g. "seq")
 
 
 def create_transformer(cfg: TransformerConfig, ff_config: FFConfig = None) -> FFModel:
@@ -40,7 +41,8 @@ def create_transformer(cfg: TransformerConfig, ff_config: FFConfig = None) -> FF
         a_in = ff.layer_norm(t, name=f"ln1_{i}") if cfg.layer_norm else t
         a = ff.multihead_attention(
             a_in, a_in, a_in, cfg.hidden_size, cfg.num_heads,
-            dropout=cfg.dropout, causal=cfg.causal, name=f"attn_{i}")
+            dropout=cfg.dropout, causal=cfg.causal,
+            seq_parallel=cfg.seq_parallel, name=f"attn_{i}")
         t = ff.add(t, a, name=f"res1_{i}")
         # FFN sublayer (dense_relu + dense, transformer.cc:31-35)
         f_in = ff.layer_norm(t, name=f"ln2_{i}") if cfg.layer_norm else t

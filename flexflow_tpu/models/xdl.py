@@ -1,0 +1,43 @@
+"""XDL click-through model (examples/cpp/XDL/xdl.cc).
+
+N large embedding tables (reference default 4x 1M vocab, dim 64,
+xdl.cc:26-31) looked up per sparse feature, concatenated (xdl.cc:79-82)
+and fed to a dense MLP ending in a binary softmax. The embedding tables
+are the parameter-parallel target, like DLRM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class XDLConfig:
+    batch_size: int = 64
+    embedding_size: Sequence[int] = (1000000,) * 4
+    sparse_feature_size: int = 64
+    embedding_bag_size: int = 1
+    mlp: Sequence[int] = (512, 256, 128, 2)
+
+
+def create_xdl(cfg: XDLConfig, ff_config: FFConfig = None) -> FFModel:
+    ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    embedded = []
+    for i, vocab in enumerate(cfg.embedding_size):
+        inp = ff.create_tensor((cfg.batch_size, cfg.embedding_bag_size),
+                               dtype=DataType.INT32, name=f"sparse_{i}")
+        e = ff.embedding(inp, vocab, cfg.sparse_feature_size,
+                         aggr=AggrMode.AGGR_MODE_SUM, name=f"emb_{i}")
+        embedded.append(e)
+    t = ff.concat(embedded, axis=-1, name="concat_emb")
+    for j, width in enumerate(cfg.mlp[:-1]):
+        t = ff.dense(t, width, activation=ActiMode.AC_MODE_RELU,
+                     name=f"mlp_d{j}")
+    t = ff.dense(t, cfg.mlp[-1], name="mlp_out")
+    t = ff.softmax(t)
+    return ff

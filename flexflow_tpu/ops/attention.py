@@ -66,6 +66,9 @@ class MultiHeadAttention(Op):
         self.dropout = p.get("dropout", 0.0)
         self.causal = p.get("causal", False)
         self.use_bias = p.get("bias", True)
+        # sequence/context parallelism: run the attention core as ring
+        # attention over this mesh axis (SURVEY §5.7 — new vs reference)
+        self.seq_parallel = p.get("seq_parallel", None)
         self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
         super().__init__(layer, input_shapes)
 
@@ -96,11 +99,42 @@ class MultiHeadAttention(Op):
         v = jnp.einsum("bse,hed->bhsd", value.astype(cd), params["wv"].astype(cd),
                        preferred_element_type=jnp.float32)
         rng = ctx.next_rng() if (self.dropout > 0 and ctx.training) else None
-        o = scaled_dot_product_attention(
-            q, k, v, causal=self.causal,
-            dropout_rate=self.dropout if ctx.training else 0.0,
-            rng=rng, compute_dtype=cd,
-        )
+        dropout_rate = self.dropout if ctx.training else 0.0
+        seq_axis = self.seq_parallel
+        mesh_axes = (dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))
+                     if ctx.mesh is not None else {})
+        if (seq_axis and mesh_axes.get(seq_axis, 1) > 1
+                and q.shape[2] == k.shape[2]):
+            if dropout_rate > 0.0 and not getattr(self, "_warned_dropout", False):
+                import warnings
+
+                warnings.warn(
+                    f"attention '{self.name}': attention-prob dropout "
+                    f"(rate={dropout_rate}) is not applied under "
+                    f"seq_parallel ring attention; training proceeds "
+                    f"without it", stacklevel=2)
+                self._warned_dropout = True
+            # ring attention over the 'seq' mesh axis: K/V rotate on the ICI
+            # ring, scores never leave the shard
+            from flexflow_tpu.parallel.ring_attention import ring_attention
+
+            o = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
+                               causal=self.causal)
+        elif (dropout_rate == 0.0 and q.shape[2] == k.shape[2]):
+            from flexflow_tpu.ops.pallas_kernels import (
+                flash_attention, flash_attention_available)
+
+            if flash_attention_available(q.shape[2], q.shape[3]):
+                o = flash_attention(q, k, v, causal=self.causal)
+            else:
+                o = scaled_dot_product_attention(
+                    q, k, v, causal=self.causal, dropout_rate=0.0,
+                    rng=None, compute_dtype=cd)
+        else:
+            o = scaled_dot_product_attention(
+                q, k, v, causal=self.causal, dropout_rate=dropout_rate,
+                rng=rng, compute_dtype=cd,
+            )
         y = jnp.einsum("bhsd,hde->bse", o.astype(cd), params["wo"].astype(cd),
                        preferred_element_type=jnp.float32)
         if self.use_bias:

@@ -41,11 +41,13 @@ class OpContext:
     """Per-call context threaded through forward: training flag, rng, policy."""
 
     def __init__(self, training: bool = False, rng: Optional[jax.Array] = None,
-                 compute_dtype=jnp.float32, seq_length: Optional[int] = None):
+                 compute_dtype=jnp.float32, seq_length: Optional[int] = None,
+                 mesh=None):
         self.training = training
         self.rng = rng
         self.compute_dtype = compute_dtype
         self.seq_length = seq_length
+        self.mesh = mesh  # jax.sharding.Mesh for ops needing manual collectives
 
     def next_rng(self) -> jax.Array:
         if self.rng is None:

@@ -1,0 +1,117 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+First-class long-context support — new scope the reference lacks entirely
+(SURVEY §5.7: FlexFlow's only sequence handling is seq_length iteration
+config; no ring attention / Ulysses / context parallelism exists there).
+
+Design: the sequence dim of Q/K/V is sharded over a 'seq' mesh axis. Each
+device holds its local Q block permanently and its K/V block initially;
+K/V blocks rotate around the ring via ``jax.lax.ppermute`` (pure ICI
+neighbor traffic, no all-gather), and each step's partial attention is
+merged with the running result using the numerically-stable streaming
+log-sum-exp accumulation of blockwise/flash attention:
+
+    m_new = max(m, m_blk);  l = l*e^{m-m_new} + l_blk*e^{m_blk-m_new}
+    o = (o*l*e^{m-m_new} + o_blk*l_blk*e^{m_blk-m_new}) / l_new
+
+Causal masking is exact: a rotating K/V block is fully visible when its
+ring index < the local index, fully masked when greater, and
+triangle-masked when equal — so later steps skip no compute but contribute
+zero probability (XLA's static schedule cannot skip iterations; the
+*communication* is what sequence parallelism saves).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _attn_block(q, k, v, scale, mask):
+    """One Q-block × KV-block partial attention.
+
+    q: [B,H,Sq,D], k/v: [B,H,Sk,D]; mask broadcastable to [B,H,Sq,Sk] or
+    None. Returns (o_blk [B,H,Sq,D] *unnormalized*, m_blk [B,H,Sq],
+    l_blk [B,H,Sq]).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    # fully-masked rows: keep m finite so exp() underflows to 0, not NaN
+    m_safe = jnp.where(jnp.isfinite(m_blk), m_blk, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    l_blk = jnp.sum(p, axis=-1)
+    o_blk = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32)
+    return o_blk, m_safe, l_blk
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body under shard_map. q,k,v: [B,H,S_loc,D] local blocks."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    sq = q.shape[2]
+    qf = q.astype(jnp.float32)
+
+    def mask_for(kv_idx):
+        if not causal:
+            return None
+        # kv block strictly earlier: visible; strictly later: masked;
+        # same block: lower triangle
+        tri = (jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :])
+        full = kv_idx < my_idx
+        none = kv_idx > my_idx
+        blk = jnp.where(none, False, jnp.where(full, True, tri))
+        return blk[None, None, :, :]
+
+    def step(carry, _):
+        o, m, l, k_cur, v_cur, kv_idx = carry
+        o_blk, m_blk, l_blk = _attn_block(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            scale, mask_for(kv_idx))
+        m_new = jnp.maximum(m, m_blk)
+        c1 = jnp.exp(m - m_new)
+        c2 = jnp.exp(m_blk - m_new)
+        o = o * c1[..., None] + o_blk * c2[..., None]
+        l = l * c1 + l_blk * c2
+        # rotate K/V to the next device on the ring (ICI neighbor hop)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_nxt = (kv_idx - 1) % n
+        return (o, m_new, l, k_nxt, v_nxt, kv_nxt), None
+
+    b, h, _, d = q.shape
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v, my_idx), None, length=n)
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                   batch_axis: Optional[str] = "data",
+                   causal: bool = False):
+    """Sequence-parallel attention. q,k,v: [B, H, S, D] global arrays whose
+    S dim is (to be) sharded over ``seq_axis``; B over ``batch_axis`` if
+    that axis exists in the mesh.
+
+    Runs under shard_map: all mesh axes manual, ppermute over the seq ring.
+    """
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ba = batch_axis if batch_axis in axes else None
+    spec = P(ba, None, seq_axis, None)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                          causal=causal)
+    # axes not named in the specs (e.g. 'model') replicate, which is the
+    # intended layout for dp x sp attention
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

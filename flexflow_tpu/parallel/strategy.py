@@ -37,16 +37,25 @@ def _axis_size(mesh, name: str) -> int:
 
 
 def data_parallel_strategy(nodes, mesh) -> Strategy:
+    """Batch dim over 'data'; if the mesh carries a 'seq' axis, SEQ-role
+    dims shard over it too (context parallelism: activations stay
+    seq-sharded between ring-attention ops)."""
     dp = _axis_size(mesh, "data")
+    sp = _axis_size(mesh, "seq")
     strategy: Strategy = {}
     for node in nodes:
         specs = []
         for shp, roles in zip(node.op.output_shapes, node.op.output_dim_roles()):
+            entries = [None] * len(shp)
             if (dp > 1 and shp and roles and roles[0] == DimRole.SAMPLE
                     and shp[0] % dp == 0):
-                specs.append(P("data", *([None] * (len(shp) - 1))))
-            else:
-                specs.append(None)
+                entries[0] = "data"
+            if sp > 1:
+                for d, role in enumerate(roles):
+                    if role == DimRole.SEQ and shp[d] % sp == 0:
+                        entries[d] = "seq"
+                        break
+            specs.append(P(*entries) if any(e for e in entries) else None)
         strategy[node.op.guid] = OpStrategy(output_specs=specs)
     return strategy
 

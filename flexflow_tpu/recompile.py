@@ -55,7 +55,10 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
         for t, s in zip(layer.outputs, op.output_shapes):
             t.shape = tuple(s)
     iters_so_far = ffmodel._iter
-    ffmodel.compile(optimizer, loss_type, metric_types, mesh=None)
+    ffmodel.compile(optimizer, loss_type, metric_types,
+                    comp_mode=ffmodel.config.computation_mode,
+                    machine_spec=ffmodel.machine_spec,
+                    mesh=ffmodel.mesh)  # keep the live mesh (and its axes)
     ffmodel._iter = iters_so_far  # compile() zeroes it; training continues
     # carry over parameters whose (name, shape) survived the alteration
     import numpy as np

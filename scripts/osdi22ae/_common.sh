@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Shared runner for the artifact-evaluation-style benchmarks: each model is
+# run twice — Unity-searched strategy vs --only-data-parallel — and prints
+# THROUGHPUT samples/s (protocol of the reference's scripts/osdi22ae/*.sh).
+# FF_TPU_DEVICES=N limits visible devices (analog of -ll:gpu N).
+set -e
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+run_pair() {
+  local example="$1"; shift
+  echo "Running $example with a parallelization strategy discovered by the search"
+  python "$REPO/examples/$example.py" "$@"
+  echo "Running $example with data parallelism"
+  python "$REPO/examples/$example.py" "$@" --only-data-parallel
+}
