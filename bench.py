@@ -57,8 +57,11 @@ def main():
 
     params, opt_state, state = ff.params, ff.opt_state, ff.state
     rng = jrandom.PRNGKey(0)
-    # warmup (compile); float() forces a real device->host sync — on the
-    # tunneled TPU backend block_until_ready alone does not.
+    # warmup (compile; a second round catches the donation-aliased
+    # recompile); float() forces a real device->host sync — on the
+    # tunneled TPU backend block_until_ready alone does not. Measured:
+    # async per-step dispatch pipelines as well as a fused lax.scan loop
+    # (make_multi_step), so the plain loop is the honest protocol.
     for _ in range(3):
         params, opt_state, state, rng, loss = step(params, opt_state, state, rng)
     float(loss)

@@ -1,0 +1,187 @@
+"""Per-op numerical alignment vs torch CPU.
+
+Mirrors the reference's tests/align strategy (SURVEY §4): the same op run
+in the framework and in PyTorch, outputs compared with epsilon. Each op is
+exercised through a single-op FFModel graph (predict path), so these also
+cover the op library's forward lowering.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.ffconst import ActiMode, DataType, PoolType
+
+RS = np.random.RandomState(0)
+B = 4
+
+
+def run_op(build, in_shapes, dtypes=None, feeds=None):
+    ff = FFModel(FFConfig(batch_size=B, only_data_parallel=True))
+    ts = []
+    for i, shp in enumerate(in_shapes):
+        dt = (dtypes or [DataType.FLOAT] * len(in_shapes))[i]
+        ts.append(ff.create_tensor((B,) + tuple(shp), dtype=dt))
+    build(ff, *ts)
+    ff.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    xs = feeds if feeds is not None else [
+        RS.randn(B, *shp).astype(np.float32) for shp in in_shapes]
+    return ff.predict(xs if len(xs) > 1 else xs[0]), xs
+
+
+def close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                               atol=atol)
+
+
+class TestDenseConvPool:
+    def test_linear_with_bias_and_relu(self):
+        out, (x,) = run_op(lambda ff, t: ff.dense(t, 8,
+                           activation=ActiMode.AC_MODE_RELU, name="d"),
+                           [(16,)])
+        ff_k = None  # recompute with torch using our weights
+
+    def test_pool2d_avg_matches_torch(self):
+        out, (x,) = run_op(lambda ff, t: ff.pool2d(t, 2, 2, 2, 2, 0, 0,
+                           pool_type=PoolType.POOL_AVG), [(3, 8, 8)])
+        want = F.avg_pool2d(torch.from_numpy(x), 2).numpy()
+        close(out, want)
+
+    def test_pool2d_max_matches_torch(self):
+        out, (x,) = run_op(lambda ff, t: ff.pool2d(t, 3, 3, 2, 2, 1, 1),
+                           [(3, 9, 9)])
+        want = F.max_pool2d(torch.from_numpy(x), 3, 2, 1).numpy()
+        close(out, want)
+
+
+class TestNormalization:
+    def test_layernorm_matches_torch(self):
+        out, (x,) = run_op(lambda ff, t: ff.layer_norm(t, name="ln"), [(6, 10)])
+        want = F.layer_norm(torch.from_numpy(x), (10,)).numpy()
+        close(out, want, rtol=1e-3, atol=1e-4)
+
+    def test_softmax_matches_torch(self):
+        out, (x,) = run_op(lambda ff, t: ff.softmax(t), [(7,)])
+        close(out, F.softmax(torch.from_numpy(x), dim=-1).numpy())
+
+
+class TestShapeOps:
+    def test_transpose_reshape_reverse(self):
+        def build(ff, t):
+            t = ff.transpose(t, (0, 2, 1))
+            t = ff.reshape(t, (B, 24))
+            return ff.reverse(t, axis=1)
+
+        out, (x,) = run_op(build, [(4, 6)])
+        want = x.transpose(0, 2, 1).reshape(B, 24)[:, ::-1]
+        close(out, want)
+
+    def test_concat_split(self):
+        def build(ff, a, b):
+            c = ff.concat([a, b], axis=1)
+            parts = ff.split(c, [3, 5], axis=1)
+            return parts[1]
+
+        out, (xa, xb) = run_op(build, [(3,), (5,)])
+        close(out, xb)
+
+    def test_flat(self):
+        out, (x,) = run_op(lambda ff, t: ff.flat(t), [(2, 3, 4)])
+        close(out, x.reshape(B, 24))
+
+
+class TestMathOps:
+    def test_batch_matmul_matches_torch(self):
+        def build(ff, a, b):
+            return ff.batch_matmul(a, b)
+
+        xa = RS.randn(B, 5, 6).astype(np.float32)
+        xb = RS.randn(B, 6, 7).astype(np.float32)
+        out, _ = run_op(build, [(5, 6), (6, 7)], feeds=[xa, xb])
+        close(out, torch.bmm(torch.from_numpy(xa), torch.from_numpy(xb)).numpy(),
+              rtol=1e-3, atol=1e-4)
+
+    def test_reduce_and_mean(self):
+        out, (x,) = run_op(lambda ff, t: ff.reduce_sum(t, [1], keepdims=False),
+                           [(5, 3)])
+        close(out, x.sum(axis=1))
+        out2, (x2,) = run_op(lambda ff, t: ff.mean(t, [1, 2]), [(5, 3)])
+        close(out2, x2.mean(axis=(1, 2)))
+
+    def test_elementwise_binary(self):
+        def build(ff, a, b):
+            t = ff.add(a, b)
+            t = ff.multiply(t, a)
+            t = ff.subtract(t, b)
+            return ff.max(t, a)
+
+        out, (xa, xb) = run_op(build, [(9,), (9,)])
+        want = np.maximum((xa + xb) * xa - xb, xa)
+        close(out, want)
+
+    def test_unary_chain(self):
+        def build(ff, t):
+            t = ff.sigmoid(t)
+            t = ff.scalar_multiply(t, 2.0)
+            t = ff.pow(t, 2.0)
+            return ff.rsqrt(t)
+
+        out, (x,) = run_op(build, [(11,)])
+        s = 1.0 / (1.0 + np.exp(-x))
+        close(out, 1.0 / np.sqrt((2 * s) ** 2), rtol=1e-3, atol=1e-4)
+
+    def test_gather_topk(self):
+        idx = RS.randint(0, 10, (B, 3)).astype(np.int32)
+        x = RS.randn(B, 10).astype(np.float32)
+
+        def build(ff, t, i):
+            return ff.gather(t, i, axis=1)
+
+        out, _ = run_op(build, [(10,), (3,)],
+                        dtypes=[DataType.FLOAT, DataType.INT32],
+                        feeds=[x, idx])
+        want = np.take_along_axis(x, idx, axis=1)
+        close(out, want)
+
+    def test_embedding_matches_weight_rows(self):
+        idx = RS.randint(0, 20, (B, 2)).astype(np.int32)
+
+        def build(ff, i):
+            return ff.embedding(i, 20, 6, name="emb")
+
+        ff = FFModel(FFConfig(batch_size=B, only_data_parallel=True))
+        t = ff.create_tensor((B, 2), dtype=DataType.INT32)
+        build(ff, t)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        w = ff.get_parameter("emb")
+        out = ff.predict(idx)
+        close(out, w[idx])
+
+
+class TestTrainingGradients:
+    def test_linear_gradient_matches_torch(self):
+        # one SGD step on y = xW + b, MSE loss: compare updated W with torch
+        x = RS.randn(B, 6).astype(np.float32)
+        y = RS.randn(B, 3).astype(np.float32)
+        ff = FFModel(FFConfig(batch_size=B, only_data_parallel=True,
+                              weight_decay=0.0, allow_mixed_precision=False))
+        t = ff.create_tensor((B, 6))
+        ff.dense(t, 3, name="d")
+        ff.compile(SGDOptimizer(lr=0.1, weight_decay=0.0),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        w0 = ff.get_parameter("d").copy()
+        b0 = ff.get_parameter("d", "bias").copy()
+        ff.set_batch(x, y)
+        ff.forward(); ff.backward(); ff.update()
+        w1 = ff.get_parameter("d")
+
+        tw = torch.tensor(w0, requires_grad=True)
+        tb = torch.tensor(b0, requires_grad=True)
+        loss = F.mse_loss(torch.from_numpy(x) @ tw + tb, torch.from_numpy(y))
+        loss.backward()
+        want = w0 - 0.1 * tw.grad.numpy()
+        close(w1, want, rtol=1e-3, atol=1e-4)
