@@ -51,7 +51,7 @@ class GraphExecutor:
         self,
         nodes: List[OpNode],
         input_names: List[str],
-        final_guid: int,
+        final_ref,
         mesh: Mesh,
         loss_type: LossType,
         metrics: Metrics,
@@ -63,7 +63,8 @@ class GraphExecutor:
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
         self.input_names = input_names
-        self.final_guid = final_guid
+        # (guid, out_idx) of the user-designated model output
+        self.final_ref = tuple(final_ref)
         self.mesh = mesh
         self.loss_type = loss_type
         self.metrics = metrics
@@ -177,7 +178,7 @@ class GraphExecutor:
                                 compute_dtype=self.compute_dtype,
                                 mesh=self.mesh)
                 values, new_state, aux = self.run_graph(p, state, inputs, ctx)
-                logits = values[(self.final_guid, 0)]
+                logits = values[self.final_ref]
                 loss = self._loss_value(logits, labels)
                 for a in aux:
                     loss = loss + a
@@ -241,7 +242,7 @@ class GraphExecutor:
             ctx = OpContext(training=False, compute_dtype=self.compute_dtype,
                             mesh=self.mesh)
             values, _, _ = self.run_graph(params, state, inputs, ctx)
-            logits = values[(self.final_guid, 0)]
+            logits = values[self.final_ref]
             loss = self._loss_value(logits, labels)
             return loss, logits, self.metrics.compute(logits, labels)
 
@@ -256,7 +257,7 @@ class GraphExecutor:
             ctx = OpContext(training=training, rng=rng,
                             compute_dtype=self.compute_dtype, mesh=self.mesh)
             values, new_state, _ = self.run_graph(params, state, inputs, ctx)
-            return values[(self.final_guid, 0)], new_state
+            return values[self.final_ref], new_state
 
         self._jit_fwd[training] = jax.jit(fwd)
         return self._jit_fwd[training]

@@ -361,7 +361,7 @@ class FFModel:
                 metrics: Sequence[MetricsType] = (),
                 comp_mode: CompMode = CompMode.TRAINING,
                 machine_spec: Optional[MachineSpec] = None,
-                mesh=None) -> None:
+                mesh=None, outputs=None) -> None:
         """Materialize ops, choose a strategy, build jitted executables.
 
         Mirrors FFModel::compile (model.cc:2802): Layer->Op materialization,
@@ -393,7 +393,34 @@ class FFModel:
 
         if not nodes:
             raise ValueError("model has no layers")
-        final_node = nodes[-1]
+        # --- output selection (get_final_operator, model.cc:2476) ---
+        # The model output is the user-designated tensor (compile(outputs=...)
+        # or the Tensor marked via self.outputs), falling back to the sole
+        # unconsumed output of the final node.
+        out_t = outputs if outputs is not None else getattr(self, "outputs", None)
+        if isinstance(out_t, (list, tuple)):
+            if len(out_t) != 1:
+                raise ValueError("exactly one output tensor is supported")
+            out_t = out_t[0]
+        # persist so recompile_on_condition's re-compile keeps the selection
+        self.outputs = out_t
+        if out_t is not None:
+            ref = tensor_ref.get(out_t.guid)
+            if ref is None or ref[0] != "op":
+                raise ValueError("outputs= must be a tensor produced by a layer")
+            final_ref = (ref[1], ref[2])
+        else:
+            final_node = nodes[-1]
+            consumed = {
+                tensor_ref[t.guid][1:]
+                for layer in self.layers
+                for t in layer.inputs
+                if tensor_ref.get(t.guid, ("x",))[0] == "op"
+            }
+            free = [i for i in range(len(final_node.op.output_shapes))
+                    if (final_node.guid, i) not in consumed]
+            final_ref = (final_node.guid, free[0] if len(free) == 1 else 0)
+        final_node = next(n for n in nodes if n.guid == final_ref[0])
         self._final_is_softmax = final_node.op.op_type == OperatorType.SOFTMAX
         self.metrics = Metrics(loss_type, list(metrics),
                                preds_are_probs=self._final_is_softmax)
@@ -492,7 +519,7 @@ class FFModel:
         )
         data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
         self.executor = GraphExecutor(
-            nodes, input_names, final_node.op.guid, self.mesh, loss_type,
+            nodes, input_names, final_ref, self.mesh, loss_type,
             self.metrics, self.optimizer, compute_dtype=compute_dtype,
             data_axes=data_axes,  # may be empty: batch replicated
             final_is_softmax=self._final_is_softmax,

@@ -19,17 +19,21 @@ RS = np.random.RandomState(0)
 B = 4
 
 
-def run_op(build, in_shapes, dtypes=None, feeds=None):
+def run_op(build, in_shapes, dtypes=None, feeds=None, return_model=False):
     ff = FFModel(FFConfig(batch_size=B, only_data_parallel=True))
     ts = []
     for i, shp in enumerate(in_shapes):
         dt = (dtypes or [DataType.FLOAT] * len(in_shapes))[i]
         ts.append(ff.create_tensor((B,) + tuple(shp), dtype=dt))
-    build(ff, *ts)
-    ff.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    out_t = build(ff, *ts)
+    ff.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               outputs=out_t)
     xs = feeds if feeds is not None else [
         RS.randn(B, *shp).astype(np.float32) for shp in in_shapes]
-    return ff.predict(xs if len(xs) > 1 else xs[0]), xs
+    out = ff.predict(xs if len(xs) > 1 else xs[0])
+    if return_model:
+        return out, xs, ff
+    return out, xs
 
 
 def close(a, b, rtol=1e-4, atol=1e-5):
@@ -39,10 +43,15 @@ def close(a, b, rtol=1e-4, atol=1e-5):
 
 class TestDenseConvPool:
     def test_linear_with_bias_and_relu(self):
-        out, (x,) = run_op(lambda ff, t: ff.dense(t, 8,
-                           activation=ActiMode.AC_MODE_RELU, name="d"),
-                           [(16,)])
-        ff_k = None  # recompute with torch using our weights
+        out, (x,), ff = run_op(
+            lambda ff, t: ff.dense(t, 8, activation=ActiMode.AC_MODE_RELU,
+                                   name="d"),
+            [(16,)], return_model=True)
+        k = ff.get_parameter("d", "kernel")
+        b = ff.get_parameter("d", "bias")
+        want = F.relu(torch.from_numpy(x) @ torch.from_numpy(k)
+                      + torch.from_numpy(b)).numpy()
+        close(out, want, rtol=1e-3, atol=1e-4)
 
     def test_pool2d_avg_matches_torch(self):
         out, (x,) = run_op(lambda ff, t: ff.pool2d(t, 2, 2, 2, 2, 0, 0,
