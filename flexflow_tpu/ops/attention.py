@@ -69,6 +69,9 @@ class MultiHeadAttention(Op):
         # sequence/context parallelism: run the attention core as ring
         # attention over this mesh axis (SURVEY §5.7 — new vs reference)
         self.seq_parallel = p.get("seq_parallel", None)
+        # head/attribute parallelism axis (set by the search when it picks a
+        # "head" choice) so ring attention keeps heads sharded in shard_map
+        self.head_parallel = p.get("head_parallel", None)
         self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
         super().__init__(layer, input_shapes)
 
@@ -119,6 +122,7 @@ class MultiHeadAttention(Op):
             from flexflow_tpu.parallel.ring_attention import ring_attention
 
             o = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
+                               head_axis=self.head_parallel,
                                causal=self.causal)
         elif (dropout_rate == 0.0 and q.shape[2] == k.shape[2]):
             from flexflow_tpu.ops.pallas_kernels import (

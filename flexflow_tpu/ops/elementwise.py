@@ -62,8 +62,7 @@ class ElementUnary(Op):
         return [_UNARY_FNS[t](x)]
 
     def output_dim_roles(self):
-        shp = self.output_shapes[0]
-        return [tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))]
+        return [_elementwise_roles(self.output_shapes[0])]
 
 
 class ElementBinary(Op):
@@ -76,8 +75,18 @@ class ElementBinary(Op):
         return [_BINARY_FNS[self.layer.op_type](a, b)]
 
     def output_dim_roles(self):
-        shp = self.output_shapes[0]
-        return [tuple(DimRole.SAMPLE if i == 0 else DimRole.OTHER for i in range(len(shp)))]
+        return [_elementwise_roles(self.output_shapes[0])]
+
+
+def _elementwise_roles(shp):
+    """dim0 sample; dim1 of a rank-3 tensor is a position dim the op treats
+    independently — declared SEQ so context parallelism flows through.
+    Rank-4 (NCHW image) activations keep dim1 = channel = OTHER."""
+    roles = [DimRole.SAMPLE if i == 0 else DimRole.OTHER
+             for i in range(len(shp))]
+    if len(shp) == 3:
+        roles[1] = DimRole.SEQ
+    return tuple(roles)
 
 
 for _t in list(_UNARY_FNS) + list(_SCALAR_FNS):

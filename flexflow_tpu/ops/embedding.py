@@ -47,8 +47,11 @@ class Embedding(Op):
         return [emb]
 
     def output_dim_roles(self):
+        # token-position dim of [B,S,E] output is a sequence dim (lookups
+        # are independent per position)
         shp = self.output_shapes[0]
-        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 2) + [DimRole.CHANNEL]
+        mid = DimRole.SEQ if len(shp) == 3 else DimRole.OTHER
+        roles = [DimRole.SAMPLE] + [mid] * (len(shp) - 2) + [DimRole.CHANNEL]
         return [tuple(roles)]
 
     def params_elems(self):

@@ -70,8 +70,11 @@ class Linear(Op):
         return [y.astype(x.dtype)]
 
     def output_dim_roles(self):
+        # dim1 of a rank-3 input is a position dim the matmul treats
+        # independently — a sequence dim the search may context-shard
         shp = self.output_shapes[0]
-        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 2) + [DimRole.CHANNEL]
+        mid = DimRole.SEQ if len(shp) == 3 else DimRole.OTHER
+        roles = [DimRole.SAMPLE] + [mid] * (len(shp) - 2) + [DimRole.CHANNEL]
         return [tuple(roles)]
 
     def flops(self):

@@ -51,6 +51,11 @@ class LayerNorm(Op):
     def output_dim_roles(self):
         shp = self.output_shapes[0]
         roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 1)
+        # dim1 of a rank-3 tensor is a position dim (normalization is per
+        # position when it is not a normalized axis) — seq-shardable
+        norm_axes = {a % len(shp) for a in self.axes}
+        if len(shp) == 3 and 1 not in norm_axes:
+            roles[1] = DimRole.SEQ
         return [tuple(roles)]
 
     def params_elems(self):
@@ -70,6 +75,13 @@ class Softmax(Op):
         (x,) = inputs
         return [jax.nn.softmax(x.astype(jnp.float32), axis=self.axis).astype(x.dtype)]
 
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 1)
+        if len(shp) == 3 and self.axis % len(shp) != 1:
+            roles[1] = DimRole.SEQ
+        return [tuple(roles)]
+
 
 @register_op(OperatorType.DROPOUT)
 class Dropout(Op):
@@ -86,3 +98,7 @@ class Dropout(Op):
             return [x]
         keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - self.rate, x.shape)
         return [jnp.where(keep, x / (1.0 - self.rate), 0).astype(x.dtype)]
+
+    def output_dim_roles(self):
+        from flexflow_tpu.ops.elementwise import _elementwise_roles
+        return [_elementwise_roles(self.output_shapes[0])]

@@ -99,19 +99,24 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
 def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                    batch_axis: Optional[str] = "data",
+                   head_axis: Optional[str] = None,
                    causal: bool = False):
     """Sequence-parallel attention. q,k,v: [B, H, S, D] global arrays whose
-    S dim is (to be) sharded over ``seq_axis``; B over ``batch_axis`` if
-    that axis exists in the mesh.
+    S dim is (to be) sharded over ``seq_axis``; B over ``batch_axis`` and
+    H over ``head_axis`` if those axes exist in the mesh (heads are
+    independent, so keeping them sharded composes head parallelism with the
+    seq ring instead of gathering heads at the shard_map boundary).
 
     Runs under shard_map: all mesh axes manual, ppermute over the seq ring.
     """
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     ba = batch_axis if batch_axis in axes else None
-    spec = P(ba, None, seq_axis, None)
+    ha = (head_axis if head_axis in axes and q.shape[1] % axes[head_axis] == 0
+          else None)
+    spec = P(ba, ha, seq_axis, None)
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
                           causal=causal)
-    # axes not named in the specs (e.g. 'model') replicate, which is the
-    # intended layout for dp x sp attention
+    # axes not named in the specs replicate, which is the intended layout
+    # for dp x sp attention
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -121,6 +121,17 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
         if st is not None:
             node.output_specs = list(st.output_specs)
             node.param_specs = dict(st.param_specs)
+            # a searched "ring" choice switches the attention op onto the
+            # ring-attention execution path over the mesh's 'seq' axis (the
+            # analog of a substitution rewrite changing the op's task
+            # implementation); "head" choices record the head-sharded axis
+            # so ring attention keeps heads distributed under shard_map
+            choice = getattr(st, "choice", None) or ""
+            if hasattr(node.op, "seq_parallel"):
+                if choice.endswith("_ring") and axis_sizes.get("seq", 1) > 1:
+                    node.op.seq_parallel = "seq"
+                if "head" in choice and axis_sizes.get("model", 1) > 1:
+                    node.op.head_parallel = "model"
         op = node.op
         is_par = getattr(op, "is_parallel_op", False)
         if (is_par and hasattr(op, "preferred_spec_update")) or (

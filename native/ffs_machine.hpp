@@ -68,6 +68,13 @@ struct MachineModel {
     return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
   }
 
+  // One full ring rotation (ring attention K/V pass): `bytes` total sent
+  // per chip over k-1 neighbor hops on one ICI link direction.
+  double ring_time(double bytes, int k) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    return ici_latency * (k - 1) + bytes / ici_bw;
+  }
+
   // All-to-all: each chip exchanges its (bytes/k) shard with k-1 peers.
   double alltoall_time(double bytes, int k) const {
     if (k <= 1 || bytes <= 0) return 0.0;

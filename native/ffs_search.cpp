@@ -336,7 +336,11 @@ Assignment mcmc_refine(const Graph& g, const std::vector<std::vector<Choice>>& c
 Json spec_to_json(const Spec& s) {
   Json arr = Json::array();
   for (int8_t e : s)
-    arr.push_back(e == kData ? Json("data") : e == kModel ? Json("model") : Json());
+    arr.push_back(e == kData     ? Json("data")
+                  : e == kModel  ? Json("model")
+                  : e == kSeq    ? Json("seq")
+                  : e == kExpert ? Json("expert")
+                                 : Json());
   return arr;
 }
 
@@ -349,17 +353,33 @@ Json optimize(const Json& req) {
     measured[kv.first] = kv.second.as_double();
   double threshold = cfg.memory_threshold > 0 ? cfg.memory_threshold : m.hbm_cap;
 
-  // outer loop: mesh factorizations (MachineView enumeration analog)
+  // outer loop: mesh factorizations (MachineView enumeration analog) —
+  // now N-D: every (data, model, seq) factorization of the chip count.
+  // A 'seq' axis is only worth enumerating when the graph carries a
+  // sequence dim (roles mark it); expert axes arrive with MoE placement.
+  int64_t seq_extent = 0;
+  for (const Node& n : g.nodes) {
+    if (n.roles.empty()) continue;
+    for (size_t d = 0; d < n.roles[0].size(); ++d)
+      if (n.roles[0][d] == Role::Seq && d < n.output_shapes[0].size())
+        seq_extent = std::max(seq_extent, n.output_shapes[0][d]);
+  }
   std::vector<MeshShape> meshes;
   int N = std::max(1, m.num_devices);
   for (int mp = 1; mp <= N; ++mp) {
     if (N % mp) continue;
-    int dp = N / mp;
-    // the host stages the batch sharded over 'data': dp must divide it
-    if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
-    if (cfg.only_data_parallel && mp > 1) continue;
-    if (!cfg.enable_parameter_parallel && mp > 1) continue;
-    meshes.push_back({dp, mp});
+    if (mp > 1 && (cfg.only_data_parallel || !cfg.enable_parameter_parallel))
+      continue;
+    for (int sp = 1; mp * sp <= N; ++sp) {
+      if ((N / mp) % sp) continue;
+      if (sp > 1 && (cfg.only_data_parallel || seq_extent % sp ||
+                     seq_extent <= 1))
+        continue;
+      int dp = N / mp / sp;
+      // the host stages the batch sharded over 'data': dp must divide it
+      if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+      meshes.push_back({dp, mp, sp, 1});
+    }
   }
 
   double best_time = 1e30;
@@ -401,6 +421,8 @@ Json optimize(const Json& req) {
   Json meshj = Json::object();
   meshj.set("data", Json((int64_t)best_mesh.dp));
   meshj.set("model", Json((int64_t)best_mesh.mp));
+  meshj.set("seq", Json((int64_t)best_mesh.sp));
+  meshj.set("expert", Json((int64_t)best_mesh.ep));
   out.set("mesh", meshj);
   Json ops = Json::object();
   for (size_t i = 0; i < g.nodes.size(); ++i) {
@@ -440,7 +462,9 @@ Json simulate_only(const Json& req) {
   MachineModel m = MachineModel::from_json(req.get("machine"));
   SearchConfig cfg = SearchConfig::from_json(req.get("config"));
   MeshShape mesh{(int)req.get("mesh").get("data").as_int(1),
-                 (int)req.get("mesh").get("model").as_int(1)};
+                 (int)req.get("mesh").get("model").as_int(1),
+                 (int)req.get("mesh").get("seq").as_int(1),
+                 (int)req.get("mesh").get("expert").as_int(1)};
   auto choices = all_choices(g, mesh, cfg);
   std::vector<Choice> cs;
   const Json& sel = req.get("assignment");

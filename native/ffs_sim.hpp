@@ -103,6 +103,13 @@ class TaskgraphSimulator {
         fwd_id[i] = add(std::move(ct));  // consumers wait on the psum
         res.comm_time += t;
       }
+      if (c.ring_bytes > 0 && c.ring_k > 1) {
+        // ring-attention K/V rotation (seq axis): runs on the ICI stream
+        double t = m_.ring_time(c.ring_bytes, c.ring_k);
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        fwd_id[i] = add(std::move(ct));
+        res.comm_time += t;
+      }
       res.memory += node_memory(n, c, mesh_, opt_state_factor_);
     }
 
@@ -120,6 +127,8 @@ class TaskgraphSimulator {
         double dur = nc.bwd + (c.psum_k > 1 && c.psum_bytes > 0
                                    ? m_.allreduce_time(c.psum_bytes, c.psum_k)
                                    : 0.0);
+        if (c.ring_bytes > 0 && c.ring_k > 1)  // bwd rotates K/V and dK/dV
+          dur += 2.0 * m_.ring_time(c.ring_bytes, c.ring_k);
         SimTask bt{SimTask::Kind::Bwd, i, dur, deps};
         bwd_id[i] = add(std::move(bt));
         res.bwd_time += dur;

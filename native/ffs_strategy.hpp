@@ -30,17 +30,33 @@
 
 namespace ffsearch {
 
-// Axis ids in a Spec entry: -1 replicated, 0 = 'data' axis, 1 = 'model' axis.
+// Axis ids in a Spec entry: -1 replicated; 0..3 name the mesh axes of the
+// (data, model, seq, expert) hybrid mesh — the N-D generalization of the
+// reference's MachineView enumeration (graph.h:221) where a view is a
+// device grid the op is laid out on.
 constexpr int8_t kRep = -1;
 constexpr int8_t kData = 0;
 constexpr int8_t kModel = 1;
+constexpr int8_t kSeq = 2;
+constexpr int8_t kExpert = 3;
 
 using Spec = std::vector<int8_t>;
 
 struct MeshShape {
-  int dp = 1;
-  int mp = 1;
-  int axis_size(int8_t axis) const { return axis == kData ? dp : axis == kModel ? mp : 1; }
+  int dp = 1;  // data axis
+  int mp = 1;  // model (tensor/attribute) axis
+  int sp = 1;  // seq (context/ring) axis
+  int ep = 1;  // expert axis
+  int axis_size(int8_t axis) const {
+    switch (axis) {
+      case kData: return dp;
+      case kModel: return mp;
+      case kSeq: return sp;
+      case kExpert: return ep;
+      default: return 1;
+    }
+  }
+  int total() const { return dp * mp * sp * ep; }
 };
 
 inline Spec rep_spec(size_t rank) { return Spec(rank, kRep); }
@@ -61,7 +77,10 @@ struct Choice {
   double psum_bytes = 0.0;             // partial-sum bytes reduced over model axis
   int psum_k = 1;
   double gradsync_bytes = 0.0;         // per-iteration gradient allreduce bytes
-  int gradsync_k = 1;                  // chips in the gradient ring (dp)
+  int gradsync_k = 1;                  // chips in the gradient ring (dp * sp)
+  double ring_bytes = 0.0;             // K/V bytes a device sends over a full
+                                       // ring-attention rotation (seq axis)
+  int ring_k = 1;                      // seq-ring size (hop count = ring_k-1)
 };
 
 // ---- reshard cost ---------------------------------------------------------
@@ -109,6 +128,26 @@ inline Spec dp_spec(const Shape& shp, int dp) {
 }
 
 inline double pbytes(const Node& n) { return (double)n.param_bytes(); }
+
+// Index of the Seq-role dim in output 0 (-1 if none).
+inline int seq_dim_of(const Node& n) {
+  if (n.roles.empty()) return -1;
+  for (size_t d = 0; d < n.roles[0].size(); ++d)
+    if (n.roles[0][d] == Role::Seq) return static_cast<int>(d);
+  return -1;
+}
+
+// Total per-device parameter bytes under a choice's param shardings.
+inline double sharded_param_bytes(const Node& n, const Choice& c,
+                                  const MeshShape& mesh) {
+  double b = 0;
+  for (const auto& kv : n.params) {
+    auto it = c.param.find(kv.first);
+    int k = it != c.param.end() ? shards_of(it->second, mesh) : 1;
+    b += (double)shape_elems(kv.second) * n.dtype_size / k;
+  }
+  return b;
+}
 
 }  // namespace detail
 
@@ -290,6 +329,72 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     c.work_div = static_cast<double>(dp_legal ? dp : 1) * mp;
     out.push_back(std::move(c));
   }
+
+  // ---- sequence/context parallelism over the 'seq' axis ------------------
+  // New scope vs the reference (SURVEY §5.7): attention becomes ring
+  // attention (K/V rotate on the ICI ring via ppermute,
+  // flexflow_tpu/parallel/ring_attention.py); seq-batchlike ops simply
+  // carry the seq-sharded layout, dividing their work like an extra batch
+  // axis. Every base choice spawns a seq-extended variant so hybrid
+  // dp x mp x sp strategies compose.
+  const int sp = mesh.sp;
+  int sd = detail::seq_dim_of(n);
+  if (sp > 1 && sd >= 0 && sd < (int)orank && div_ok(oshp[sd], sp)) {
+    const int64_t seq_extent = oshp[sd];
+    // an op that marks a Seq role declares that dim position-independent
+    // (shardable); attention additionally needs the ring rewrite and only
+    // supports it for self-attention (equal q/k/v sequence extents)
+    bool is_attn = t == "MULTIHEAD_ATTENTION";
+    bool self_attn = true;
+    for (const Shape& is : n.input_shapes)
+      if ((int)is.size() <= sd || is[sd] != seq_extent) self_attn = false;
+    if (!is_attn || self_attn) {
+      const size_t base_count = out.size();
+      for (size_t bi = 0; bi < base_count; ++bi) {
+        Choice c = out[bi];
+        if ((int)c.out[0].size() <= sd || c.out[0][sd] != kRep) continue;
+        c.name += is_attn ? "_ring" : "_sp";
+        for (size_t i = 0; i < n.output_shapes.size(); ++i) {
+          const Shape& os = n.output_shapes[i];
+          if ((int)os.size() > sd && os[sd] == seq_extent &&
+              c.out[i][sd] == kRep)
+            c.out[i][sd] = kSeq;
+        }
+        for (size_t i = 0; i < n.input_shapes.size(); ++i) {
+          const Shape& is = n.input_shapes[i];
+          if ((int)is.size() > sd && is[sd] == seq_extent &&
+              c.in[i][sd] == kRep)
+            c.in[i][sd] = kSeq;
+        }
+        c.work_div *= sp;
+        // row-parallel partial sums shrink with the seq-sharded output
+        if (c.psum_bytes > 0) c.psum_bytes /= sp;
+        if (is_attn) {
+          // K/V rotation cost: each device sends its projected K+V block
+          // (sp-1) times around the seq ring. Block bytes = global K+V
+          // (~2x the [B,S,E] output) over all sharding of B/H/S.
+          int eff_dp = (!c.out[0].empty() && c.out[0][0] == kData) ? dp : 1;
+          auto wk = c.param.find("wk");
+          int eff_mp = (wk != c.param.end() && !wk->second.empty() &&
+                        wk->second[0] == kModel) ? mesh.mp : 1;
+          double kv_global = 2.0 * (double)n.output_bytes(0);
+          c.ring_bytes = kv_global / ((double)eff_dp * eff_mp * sp) * (sp - 1);
+          c.ring_k = sp;
+        }
+        // weights are replicated over the seq axis: their gradients reduce
+        // over seq as well as data
+        if (!n.params.empty() && n.param_bytes() > 0) {
+          if (c.gradsync_bytes > 0) {
+            c.gradsync_k *= sp;
+          } else {
+            c.gradsync_bytes = detail::sharded_param_bytes(n, c, mesh);
+            c.gradsync_k = sp;
+          }
+        }
+        out.push_back(std::move(c));
+      }
+    }
+  }
   return out;
 }
 
@@ -312,6 +417,11 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     double t = m.allreduce_time(c.psum_bytes, c.psum_k);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
   }
+  if (c.ring_bytes > 0 && c.ring_k > 1) {
+    // ring attention K/V rotation; the backward rotates K/V and dK/dV
+    double t = m.ring_time(c.ring_bytes, c.ring_k);
+    nc.comm += training ? 3.0 * t : t;
+  }
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
     nc.gradsync = m.allreduce_time(c.gradsync_bytes, c.gradsync_k);
   return nc;
@@ -321,12 +431,7 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
 // state) + sharded activations (kept for backward).
 inline double node_memory(const Node& n, const Choice& c, const MeshShape& mesh,
                           double opt_state_factor) {
-  double mem = 0;
-  for (const auto& kv : n.params) {
-    auto it = c.param.find(kv.first);
-    int k = it != c.param.end() ? shards_of(it->second, mesh) : 1;
-    mem += (double)shape_elems(kv.second) * n.dtype_size / k * (1.0 + opt_state_factor);
-  }
+  double mem = detail::sharded_param_bytes(n, c, mesh) * (1.0 + opt_state_factor);
   for (size_t i = 0; i < n.output_shapes.size(); ++i) {
     int k = i < c.out.size() ? shards_of(c.out[i], mesh) : 1;
     mem += (double)n.output_bytes(i) / k;

@@ -107,6 +107,58 @@ class TestNativeSearch:
                                 "measured": {}, "nodes": nodes})
         assert resp["mesh"]["data"] * resp["mesh"]["model"] == 8
 
+    def test_long_seq_small_batch_picks_seq_axis(self):
+        # batch 2 with 2 heads on 8 chips: dp<=2 and head-parallel mp<=2, so
+        # full utilization of the attention core (the dominant cost at
+        # s=65536) requires a seq axis — the search must discover ring
+        # attention (reference has no analog; SURVEY §5.7 new scope)
+        b, s, e, hds = 2, 65536, 512, 2
+        nodes = [{
+            "guid": 1, "type": "MULTIHEAD_ATTENTION", "name": "attn",
+            "inputs": [[-1, 0], [-1, 0], [-1, 0]],
+            "input_shapes": [[b, s, e]] * 3, "output_shapes": [[b, s, e]],
+            "roles": [["sample", "seq", "channel"]],
+            "params": {"wq": [hds, e, e // hds], "wk": [hds, e, e // hds],
+                       "wv": [hds, e, e // hds], "wo": [hds, e // hds, e]},
+            "flops": 4.0 * b * s * e * e + 2.0 * b * s * s * e * 2,
+            "dtype_size": 2, "attrs": {"num_heads": hds},
+        }]
+        resp = native_optimize({"machine": MACHINE, "config": _cfg(budget=0),
+                                "measured": {}, "nodes": nodes})
+        assert resp["mesh"]["seq"] > 1, resp["mesh"]
+        assert resp["ops"]["1"]["choice"].endswith("_ring")
+        # the output spec carries the seq axis on the sequence dim
+        assert resp["ops"]["1"]["outputs"][0][1] == "seq"
+
+    def test_seq_sharding_flows_through_batchlike_ops(self):
+        # attention (ring) -> relu -> linear: the intermediate ops must be
+        # able to carry the seq-sharded layout (no gather between them)
+        b, s, e, hds = 2, 65536, 512, 2
+        attn = {
+            "guid": 1, "type": "MULTIHEAD_ATTENTION", "name": "attn",
+            "inputs": [[-1, 0], [-1, 0], [-1, 0]],
+            "input_shapes": [[b, s, e]] * 3, "output_shapes": [[b, s, e]],
+            "roles": [["sample", "seq", "channel"]],
+            "params": {"wq": [hds, e, e // hds], "wk": [hds, e, e // hds],
+                       "wv": [hds, e, e // hds], "wo": [hds, e // hds, e]},
+            "flops": 4.0 * b * s * e * e + 2.0 * b * s * s * e * 2,
+            "dtype_size": 2, "attrs": {"num_heads": hds},
+        }
+        relu = {"guid": 2, "type": "RELU", "name": "r", "inputs": [[1, 0]],
+                "input_shapes": [[b, s, e]], "output_shapes": [[b, s, e]],
+                "roles": [["sample", "seq", "other"]], "params": {},
+                "flops": float(b * s * e), "dtype_size": 2, "attrs": {}}
+        lin = {"guid": 3, "type": "LINEAR", "name": "l", "inputs": [[2, 0]],
+               "input_shapes": [[b, s, e]], "output_shapes": [[b, s, e]],
+               "roles": [["sample", "seq", "channel"]],
+               "params": {"kernel": [e, e], "bias": [e]},
+               "flops": 2.0 * b * s * e * e, "dtype_size": 2, "attrs": {}}
+        resp = native_optimize({"machine": MACHINE, "config": _cfg(budget=0),
+                                "measured": {}, "nodes": [attn, relu, lin]})
+        assert resp["mesh"]["seq"] > 1, resp["mesh"]
+        for g in ("1", "2", "3"):
+            assert resp["ops"][g]["outputs"][0][1] == "seq", (g, resp["ops"][g])
+
     def test_substitution_rules_restrict_choices(self):
         nodes = mlp_graph(b=8, d=8192, h=8192)
         resp = native_optimize({
@@ -202,6 +254,36 @@ class TestCompileIntegration:
         rs = np.random.RandomState(0)
         ff.fit(rs.randn(12, 16).astype(np.float32),
                rs.randn(12, 4).astype(np.float32), epochs=1, verbose=False)
+
+    def test_search_discovers_seq_parallel_transformer(self):
+        # long-seq BERT proxy, tiny batch + few heads: the searched strategy
+        # must carry a seq mesh axis, switch attention onto the ring path,
+        # and the whole thing must execute on the virtual 8-device mesh
+        from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+        from flexflow_tpu.ffconst import OperatorType
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+
+        cfg = TransformerConfig(num_layers=1, hidden_size=64, num_heads=2,
+                                seq_length=512, batch_size=2)
+        ff_cfg = FFConfig(batch_size=2, search_budget=2,
+                          enable_parameter_parallel=True)
+        ff = create_transformer(cfg, ff_cfg)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                   [MetricsType.MEAN_SQUARED_ERROR])
+        assert ff.search_info is not None
+        axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+        assert axes.get("seq", 1) > 1, axes
+        attn_ops = [n.op for n in ff.executor.nodes
+                    if n.op.op_type == OperatorType.MULTIHEAD_ATTENTION]
+        assert attn_ops and all(op.seq_parallel == "seq" for op in attn_ops)
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 512, 64).astype(np.float32)
+        y = rs.randn(4, 512, 1).astype(np.float32)
+        ff.fit(x, y, epochs=1, verbose=False)  # ring attention executes
+        out = ff.predict(x[:2])
+        assert np.isfinite(np.asarray(out)).all()
 
     def test_strategy_export_import_roundtrip(self, tmp_path):
         from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
