@@ -306,13 +306,37 @@ class FFModel:
                                 dict(num_batches=num_batches, score_fn=score_fn), name)
         return self._finish(layer)
 
+    def experts(self, input: Tensor, gate: Tensor, n: int, k: int,
+                hidden_size: int, alpha: float = 2.0,
+                lambda_bal: float = 0.0, expert_parallel=None,
+                name=None) -> Tensor:
+        """Fused MoE experts op: top-k dispatch -> stacked expert FFN ->
+        gate-weighted combine. Stacked weights [E, ...] shard over an
+        'expert' mesh axis (ops/experts.py; the TPU fusion of the
+        reference's per-expert Linear placement, moe.cc:65-83)."""
+        layer = self._add_layer(
+            OperatorType.EXPERTS, [input, gate],
+            dict(n=n, k=k, hidden_size=hidden_size, alpha=alpha,
+                 lambda_bal=lambda_bal, expert_parallel=expert_parallel),
+            name)
+        return self._finish(layer)
+
     def moe(self, input: Tensor, num_exp: int, num_select: int,
             expert_hidden_size: int, alpha: float = 2.0,
-            lambda_bal: float = 0.04, name=None) -> Tensor:
+            lambda_bal: float = 0.04, fused: bool = True, name=None) -> Tensor:
         """MoE sugar layer (model.h:507-512): softmax gate -> topk ->
-        group_by -> per-expert dense -> aggregate."""
+        group_by -> per-expert dense -> aggregate. With ``fused=True``
+        (default) the dispatch/experts/combine run as the single Experts op
+        the search can expert-shard; ``fused=False`` builds the reference's
+        literal subgraph. Note the two forms have different parameter trees
+        (stacked [E, ...] weights vs per-expert dense layers), so
+        checkpoints are not interchangeable between them."""
         gate = self.dense(input, num_exp, name=f"{name or 'moe'}_gate")
         gate = self.softmax(gate)
+        if fused:
+            return self.experts(input, gate, num_exp, num_select,
+                                expert_hidden_size, alpha, lambda_bal,
+                                name=f"{name or 'moe'}_experts")
         topk_out = self.top_k(gate, num_select)
         topk_values, topk_assign = topk_out
         grouped = self.group_by(input, topk_assign, num_exp, alpha,

@@ -1,0 +1,105 @@
+"""Fused MoE Experts op: gate -> top-k dispatch -> expert FFN -> combine.
+
+TPU-native fusion of the reference's MoE subgraph (topk + group_by +
+per-expert Linear pairs + aggregate, model.h:507-512 `FFModel::moe` and
+examples/cpp/mixture_of_experts/moe.cc:42-53): under SPMD the per-expert
+ops cannot live on different devices, so the experts become one op with
+stacked weights [E, ...] whose leading dim is sharded over the 'expert'
+mesh axis — the placement the reference's search assigns per-op
+(moe.cc:65-83) becomes a sharding choice on this node. Dispatch runs as
+einsums on replicated routing tensors; with an expert axis the token
+exchange is an explicit reduce-scatter/all-gather pair inside shard_map
+(parallel/expert.py).
+
+The load-balance auxiliary loss uses the FULL top-k assignment (every
+selected expert counts toward the token fraction), matching the reference's
+Aggregate backward which accumulates over all k slots (src/ops/aggregate.cu
+agg_backward_kernel loops k).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.initializers import DefaultWeightInitializer
+from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
+from flexflow_tpu.ops.moe import (expert_capacity, load_balance_loss,
+                                  make_dispatch_tensors)
+
+
+@register_op(OperatorType.EXPERTS)
+class Experts(Op):
+    """inputs: (x [B, D], gate [B, E] router probabilities) -> [B, D]."""
+
+    def __init__(self, layer, input_shapes):
+        p = layer.properties
+        self.n_experts = p["n"]
+        self.k = p.get("k", 1)
+        self.hidden_size = p["hidden_size"]
+        self.alpha = p.get("alpha", 2.0)
+        self.lambda_bal = p.get("lambda_bal", 0.0)
+        # mesh axis experts are sharded over; set by the search when it
+        # picks an "_ep" choice (or by the user at build time)
+        self.expert_parallel = p.get("expert_parallel", None)
+        self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        b, d = self.input_shapes[0]
+        return [(b, d)]
+
+    def init_params(self, rng):
+        e = self.n_experts
+        d = self.input_shapes[0][-1]
+        h = self.hidden_size
+        ks = jax.random.split(rng, 2)
+        return {
+            "w_h": self.kernel_init(ks[0], (e, d, h)),
+            "b_h": jnp.zeros((e, h)),
+            "w_o": self.kernel_init(ks[1], (e, h, d)),
+            "b_o": jnp.zeros((e, d)),
+        }
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x, gate = inputs
+        b = x.shape[0]
+        values, assign = jax.lax.top_k(gate, self.k)
+        cap = expert_capacity(b, self.k, self.n_experts, self.alpha)
+        dispatch, combine = make_dispatch_tensors(
+            assign, values.astype(jnp.float32), self.n_experts, cap)
+
+        from flexflow_tpu.parallel.expert import (_mesh_axes, dense_moe_ffn,
+                                                  expert_parallel_ffn)
+
+        axis = self.expert_parallel
+        mesh_axes = _mesh_axes(ctx.mesh) if ctx.mesh is not None else {}
+        if axis and mesh_axes.get(axis, 1) > 1:
+            y = expert_parallel_ffn(
+                x, dispatch, combine, params["w_h"], params["b_h"],
+                params["w_o"], params["b_o"], ctx.mesh, expert_axis=axis)
+        else:
+            y = dense_moe_ffn(x, dispatch, combine, params["w_h"],
+                              params["b_h"], params["w_o"], params["b_o"])
+
+        if self.lambda_bal > 0.0:
+            self._aux_loss = load_balance_loss(assign, gate, self.n_experts,
+                                               self.lambda_bal)
+        return [y]
+
+    def output_dim_roles(self):
+        return [(DimRole.SAMPLE, DimRole.CHANNEL)]
+
+    def flops(self):
+        b, d = self.input_shapes[0]
+        cap = expert_capacity(b, self.k, self.n_experts, self.alpha)
+        e, h = self.n_experts, self.hidden_size
+        ffn = 2 * e * cap * d * h * 2
+        route = 2 * b * self.k * e * cap * d * 2  # dispatch + combine einsums
+        return ffn + route
+
+    def params_elems(self):
+        e, h = self.n_experts, self.hidden_size
+        d = self.input_shapes[0][-1]
+        return e * (d * h + h + h * d + d)

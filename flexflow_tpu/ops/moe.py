@@ -25,6 +25,17 @@ def expert_capacity(batch: int, k: int, n_experts: int, alpha: float) -> int:
     return max(1, int(math.ceil(alpha * k * batch / n_experts)))
 
 
+def load_balance_loss(assign, gate, n_experts: int, lambda_bal: float):
+    """Switch/GShard auxiliary loss: lambda * E * <f, P> with f the token
+    fraction per expert over ALL top-k slots (the reference's Aggregate
+    backward loops every k slot, src/ops/aggregate.cu agg_backward_kernel)
+    and P the mean router probability. assign [B,K] int, gate [B,E]."""
+    f = jnp.mean(jax.nn.one_hot(assign, n_experts, dtype=jnp.float32),
+                 axis=(0, 1))
+    p_mean = jnp.mean(gate.astype(jnp.float32), axis=0)
+    return lambda_bal * n_experts * jnp.sum(f * p_mean)
+
+
 def make_dispatch_tensors(assign, gates, n_experts: int, capacity: int):
     """assign [B,K] int, gates [B,K] -> dispatch [B,K,E,C] bool-ish f32,
     combine [B,K,E,C] f32 (gate-weighted), overflow dropped."""
@@ -107,16 +118,9 @@ class Aggregate(Op):
         stacked = jnp.stack(expert_outs, axis=0).astype(jnp.float32)  # [E,C,D]
         out = jnp.einsum("bkec,ecd->bd", combine, stacked)
         if self.lambda_bal > 0.0 and len(inputs) >= 4 + self.n_experts:
-            # load-balance auxiliary loss (the reference folds this into
-            # Aggregate's gate gradient, aggregate.cu): E * <f, P> with
-            # f = token fraction per expert, P = mean router probability;
-            # inputs[3] is the full gate output [B, E] from the moe sugar.
-            full_gate = inputs[3].astype(jnp.float32)
-            f = jnp.mean(
-                jax.nn.one_hot(gate_assign[:, 0], self.n_experts), axis=0
-            )
-            p_mean = jnp.mean(full_gate, axis=0)
-            self._aux_loss = self.lambda_bal * self.n_experts * jnp.sum(f * p_mean)
+            # inputs[3] is the full gate output [B, E] from the moe sugar
+            self._aux_loss = load_balance_loss(
+                gate_assign, inputs[3], self.n_experts, self.lambda_bal)
         return [out.astype(expert_outs[0].dtype)]
 
     def output_dim_roles(self):

@@ -132,6 +132,10 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
                     node.op.seq_parallel = "seq"
                 if "head" in choice and axis_sizes.get("model", 1) > 1:
                     node.op.head_parallel = "model"
+            if (hasattr(node.op, "expert_parallel")
+                    and choice.endswith("_ep")
+                    and axis_sizes.get("expert", 1) > 1):
+                node.op.expert_parallel = "expert"
         op = node.op
         is_par = getattr(op, "is_parallel_op", False)
         if (is_par and hasattr(op, "preferred_spec_update")) or (

@@ -31,7 +31,8 @@ def _param_shapes(op) -> Dict[str, List[int]]:
 
 def _node_attrs(op) -> Dict[str, Any]:
     attrs = {}
-    for k in ("num_heads", "groups", "axis", "out_dim", "k", "n"):
+    for k in ("num_heads", "groups", "axis", "out_dim", "k", "n",
+              "n_experts", "hidden_size", "alpha"):
         v = getattr(op, k, None)
         if isinstance(v, (int, float)):
             attrs[k] = v

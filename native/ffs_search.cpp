@@ -358,7 +358,10 @@ Json optimize(const Json& req) {
   // A 'seq' axis is only worth enumerating when the graph carries a
   // sequence dim (roles mark it); expert axes arrive with MoE placement.
   int64_t seq_extent = 0;
+  int64_t num_experts = 0;
   for (const Node& n : g.nodes) {
+    if (n.type == "EXPERTS")
+      num_experts = std::max(num_experts, n.attrs.get("n_experts").as_int(0));
     if (n.roles.empty()) continue;
     for (size_t d = 0; d < n.roles[0].size(); ++d)
       if (n.roles[0][d] == Role::Seq && d < n.output_shapes[0].size())
@@ -375,10 +378,16 @@ Json optimize(const Json& req) {
       if (sp > 1 && (cfg.only_data_parallel || seq_extent % sp ||
                      seq_extent <= 1))
         continue;
-      int dp = N / mp / sp;
-      // the host stages the batch sharded over 'data': dp must divide it
-      if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
-      meshes.push_back({dp, mp, sp, 1});
+      for (int ep = 1; mp * sp * ep <= N; ++ep) {
+        if ((N / mp / sp) % ep) continue;
+        if (ep > 1 && (cfg.only_data_parallel || num_experts % ep ||
+                       num_experts <= 1))
+          continue;
+        int dp = N / mp / sp / ep;
+        // the host stages the batch sharded over 'data': dp must divide it
+        if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+        meshes.push_back({dp, mp, sp, ep});
+      }
     }
   }
 

@@ -311,6 +311,42 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         out.push_back(std::move(c));
       }
     }
+  } else if (t == "EXPERTS" && mesh.ep > 1) {
+    // expert parallelism: the stacked expert weights [E, ...] shard over
+    // the 'expert' mesh axis; token dispatch/combine is the
+    // reduce-scatter + all-gather exchange of parallel/expert.py (cost ~ an
+    // all-reduce of the [E, C, D] grouped activations). This is the SPMD
+    // form of the reference's per-expert device placement (moe.cc:65-83).
+    int64_t experts = n.attrs.get("n_experts").as_int(0);
+    int ep = mesh.ep;
+    if (experts > 0 && div_ok(experts, ep)) {
+      const size_t base_count = out.size();
+      for (size_t bi = 0; bi < base_count; ++bi) {
+        Choice c = out[bi];
+        int eff_dp = (!c.out[0].empty() && c.out[0][0] == kData) ? dp : 1;
+        // the runtime shards tokens over data x expert jointly
+        // (parallel/expert.py falls back to the dense path otherwise) —
+        // don't offer a plan the executor would refuse
+        if (!div_ok(batch, (int64_t)eff_dp * ep)) continue;
+        c.name += "_ep";
+        for (auto& kv : c.param)
+          if (!kv.second.empty() && kv.second[0] == kRep)
+            kv.second[0] = kExpert;
+        c.work_div *= ep;
+        // grouped activations [E, C, D] (f32) cross the expert axis twice
+        // (reduce-scatter in, all-gather out) ~= one all-reduce
+        double alpha_cap = n.attrs.get("alpha").as_double(2.0);
+        double kk = (double)n.attrs.get("k").as_int(1);
+        int64_t b_tokens = orank ? oshp[0] : 1;
+        int64_t d_model = orank ? oshp.back() : 1;
+        c.psum_bytes = alpha_cap * kk * (double)b_tokens * d_model * 4.0 /
+                       eff_dp;
+        c.psum_k = ep;
+        c.gradsync_bytes = detail::pbytes(n) / ep;
+        c.gradsync_k = eff_dp;
+        out.push_back(std::move(c));
+      }
+    }
   } else if ((t.rfind("EW_", 0) == 0 || t == "RELU" || t == "GELU" ||
               t == "SIGMOID" || t == "TANH" || t == "ELU" || t == "EXP" ||
               t == "SIN" || t == "COS" || t == "POW" || t == "RSQRT" ||

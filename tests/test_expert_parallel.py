@@ -1,0 +1,193 @@
+"""Expert parallelism: fused Experts op + 'expert' mesh axis (SURVEY §2.3;
+reference analog: per-expert placement, examples/cpp/mixture_of_experts/
+moe.cc:65-83).
+
+Numerics contract: the shard_map expert-parallel path must match the dense
+(replicated) path bit-for-bit up to float tolerance, because the routing
+tensors are computed from replicated gate/assign.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.machine import make_mesh
+from flexflow_tpu.parallel.expert import dense_moe_ffn, expert_parallel_ffn
+from flexflow_tpu.ops.moe import expert_capacity, make_dispatch_tensors
+
+
+def _routing(rs, b, k, e, cap):
+    gate = jax.nn.softmax(jnp.asarray(rs.randn(b, e).astype(np.float32)))
+    values, assign = jax.lax.top_k(gate, k)
+    dispatch, combine = make_dispatch_tensors(assign, values, e, cap)
+    return gate, dispatch, combine
+
+
+class TestExpertParallelFFN:
+    @pytest.mark.parametrize("mesh_axes", [
+        {"expert": 8}, {"data": 2, "expert": 4}, {"data": 4, "expert": 2},
+    ])
+    def test_matches_dense_path(self, mesh_axes):
+        rs = np.random.RandomState(0)
+        b, d, h, e, k = 16, 8, 12, 8, 2
+        cap = expert_capacity(b, k, e, 2.0)
+        _, dispatch, combine = _routing(rs, b, k, e, cap)
+        x = jnp.asarray(rs.randn(b, d).astype(np.float32))
+        w_h = jnp.asarray(rs.randn(e, d, h).astype(np.float32) * 0.1)
+        b_h = jnp.asarray(rs.randn(e, h).astype(np.float32) * 0.1)
+        w_o = jnp.asarray(rs.randn(e, h, d).astype(np.float32) * 0.1)
+        b_o = jnp.asarray(rs.randn(e, d).astype(np.float32) * 0.1)
+
+        want = dense_moe_ffn(x, dispatch, combine, w_h, b_h, w_o, b_o)
+        mesh = make_mesh(int(np.prod(list(mesh_axes.values()))), mesh_axes)
+        got = expert_parallel_ffn(x, dispatch, combine, w_h, b_h, w_o, b_o,
+                                  mesh, expert_axis="expert")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_falls_back_when_experts_indivisible(self):
+        rs = np.random.RandomState(1)
+        b, d, h, e, k = 8, 4, 6, 3, 1  # 3 experts on expert axis of 2
+        cap = expert_capacity(b, k, e, 2.0)
+        _, dispatch, combine = _routing(rs, b, k, e, cap)
+        x = jnp.asarray(rs.randn(b, d).astype(np.float32))
+        w_h = jnp.asarray(rs.randn(e, d, h).astype(np.float32) * 0.1)
+        b_h = jnp.zeros((e, h))
+        w_o = jnp.asarray(rs.randn(e, h, d).astype(np.float32) * 0.1)
+        b_o = jnp.zeros((e, d))
+        mesh = make_mesh(2, {"expert": 2})
+        got = expert_parallel_ffn(x, dispatch, combine, w_h, b_h, w_o, b_o,
+                                  mesh, expert_axis="expert")
+        want = dense_moe_ffn(x, dispatch, combine, w_h, b_h, w_o, b_o)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestExpertsOpEndToEnd:
+    def _build(self, mesh, expert_parallel):
+        from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                                  SGDOptimizer)
+
+        ff = FFModel(FFConfig(batch_size=16))
+        t = ff.create_tensor((16, 8))
+        gate = ff.dense(t, 8, name="gate")
+        gate = ff.softmax(gate)
+        out = ff.experts(t, gate, n=8, k=2, hidden_size=12, alpha=2.0,
+                         lambda_bal=0.01, expert_parallel=expert_parallel,
+                         name="ex")
+        ff.compile(SGDOptimizer(lr=0.003),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                   [MetricsType.MEAN_SQUARED_ERROR], mesh=mesh)
+        return ff
+
+    def test_sharded_matches_dense_and_trains(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 8).astype(np.float32)
+        y = rs.randn(16, 8).astype(np.float32)
+
+        mesh = make_mesh(8, {"data": 2, "expert": 4})
+        ff = self._build(mesh, expert_parallel="expert")
+        out_sharded = np.asarray(ff.predict(x))
+
+        ff2 = self._build(make_mesh(1, {"data": 1}), None)
+        ff2.params = jax.device_put(jax.tree.map(np.asarray, ff.params))
+        out_dense = np.asarray(ff2.predict(x))
+        np.testing.assert_allclose(out_sharded, out_dense, rtol=1e-4,
+                                   atol=1e-4)
+
+        hist = []
+        for _ in range(3):
+            ff.fit(x, y, epochs=1, verbose=False)
+            hist.append(ff.evaluate(x, y)["loss"])
+        assert hist[-1] < hist[0]  # trains through the shard_map path
+
+    def test_load_balance_uses_all_topk_slots(self):
+        # the aux loss must be E * <f, P> with f the token fraction over
+        # ALL top-k slots (regression: f was computed from slot 0 only)
+        from flexflow_tpu.layer import Layer
+        from flexflow_tpu.ffconst import OperatorType
+        from flexflow_tpu.ops.base import OpContext, OpRegistry
+
+        b, d, e, k = 8, 4, 4, 2
+        layer = Layer(OperatorType.EXPERTS, "ex", [])
+        layer.properties.update(dict(
+            n=e, k=k, hidden_size=6, alpha=2.0, lambda_bal=1.0))
+        op = OpRegistry.create(layer, [(b, d), (b, e)])
+        params = op.init_params(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(b, d).astype(np.float32))
+        gate = np.asarray(jax.nn.softmax(
+            jnp.asarray(rs.randn(b, e).astype(np.float32))))
+
+        _, assign = jax.lax.top_k(jnp.asarray(gate), k)
+        assign = np.asarray(assign)
+        p_mean = gate.mean(0)
+        f_full = np.zeros(e)
+        for col in range(k):
+            f_full += np.bincount(assign[:, col], minlength=e)
+        f_full /= b * k
+        f_top1 = np.bincount(assign[:, 0], minlength=e) / b
+        want_full = e * np.sum(f_full * p_mean)
+        want_top1 = e * np.sum(f_top1 * p_mean)
+        assert want_full != pytest.approx(want_top1)  # discriminating gate
+
+        ctx = OpContext(training=True, compute_dtype=jnp.float32)
+        op.forward(params, [x, jnp.asarray(gate)], ctx)
+        assert float(op._aux_loss) == pytest.approx(want_full, rel=1e-5)
+
+
+class TestSearchDiscoversExpertParallel:
+    def test_fat_experts_pick_expert_axis(self):
+        from flexflow_tpu.search.native import available, native_optimize
+        if not available():
+            pytest.skip("native ffsearch library unavailable")
+        b, d, h, e = 8, 4096, 4096, 8
+        nodes = [{
+            "guid": 1, "type": "EXPERTS", "name": "ex",
+            "inputs": [[-1, 0], [-1, 0]],
+            "input_shapes": [[b, d], [b, e]], "output_shapes": [[b, d]],
+            "roles": [["sample", "channel"]],
+            "params": {"w_h": [e, d, h], "b_h": [e, h],
+                       "w_o": [e, h, d], "b_o": [e, d]},
+            "flops": 4.0 * e * 2 * b * d * h, "dtype_size": 4,
+            "attrs": {"n_experts": e, "k": 2, "alpha": 2.0,
+                      "hidden_size": h},
+        }]
+        machine = {"num_devices": 8, "flops": 197e12, "hbm_bw": 0.82e12,
+                   "hbm_cap": 16e9, "ici_bw": 45e9, "ici_latency": 1e-6,
+                   "dcn_bw": 25e9, "dcn_latency": 1e-5, "num_slices": 1}
+        cfg = dict(budget=0, alpha=0.05, only_data_parallel=False,
+                   enable_parameter_parallel=True, overlap=True,
+                   training=True, memory_threshold=0, seed=1, rules=[])
+        resp = native_optimize({"machine": machine, "config": cfg,
+                                "measured": {}, "nodes": nodes})
+        assert resp["mesh"]["expert"] > 1, resp["mesh"]
+        assert resp["ops"]["1"]["choice"].endswith("_ep")
+        assert resp["ops"]["1"]["params"]["w_h"][0] == "expert"
+
+    def test_searched_moe_model_runs_expert_parallel(self):
+        from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                                  SGDOptimizer)
+        from flexflow_tpu.ffconst import OperatorType
+        from flexflow_tpu.search.native import available
+        if not available():
+            pytest.skip("native ffsearch library unavailable")
+
+        ff = FFModel(FFConfig(batch_size=8, search_budget=2,
+                              enable_parameter_parallel=True))
+        t = ff.create_tensor((8, 64))
+        out = ff.moe(t, num_exp=8, num_select=2, expert_hidden_size=512,
+                     lambda_bal=0.01, name="m")
+        out = ff.dense(out, 4)
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+        rs = np.random.RandomState(0)
+        ff.fit(rs.randn(8, 64).astype(np.float32),
+               rs.randn(8, 4).astype(np.float32), epochs=1, verbose=False)
+        if axes.get("expert", 1) > 1:
+            ops = [n.op for n in ff.executor.nodes
+                   if n.op.op_type == OperatorType.EXPERTS]
+            assert ops and ops[0].expert_parallel == "expert"
