@@ -110,6 +110,13 @@ class TaskgraphSimulator {
         fwd_id[i] = add(std::move(ct));
         res.comm_time += t;
       }
+      if (c.gather_bytes > 0 && c.gather_k > 1) {
+        // all-gather a Combine boundary forces
+        double t = m_.allgather_time(c.gather_bytes, c.gather_k);
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        fwd_id[i] = add(std::move(ct));
+        res.comm_time += t;
+      }
       res.memory += node_memory(n, c, mesh_, opt_state_factor_);
     }
 

@@ -81,6 +81,8 @@ struct Choice {
   double ring_bytes = 0.0;             // K/V bytes a device sends over a full
                                        // ring-attention rotation (seq axis)
   int ring_k = 1;                      // seq-ring size (hop count = ring_k-1)
+  double gather_bytes = 0.0;           // all-gather a parallel-op boundary
+  int gather_k = 1;                    // (Combine) forces
 };
 
 // ---- reshard cost ---------------------------------------------------------
@@ -311,6 +313,35 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         out.push_back(std::move(c));
       }
     }
+  } else if (t == "REPARTITION" || t == "COMBINE" || t == "REPLICATE" ||
+             t == "REDUCTION") {
+    // Explicit PCG constraint boundaries (ops/parallel_ops.py): price the
+    // collective each boundary forces, so the substitution engine's
+    // moves/eliminations of these nodes change the searched cost. The
+    // degree must equal the mesh axis extent to be realizable (the Python
+    // strategy applier enforces the same for Repartition).
+    int64_t dim = n.attrs.get("dim").as_int(0);
+    int64_t deg = n.attrs.get("degree").as_int(1);
+    int8_t ax = dim == 0 ? kData : kModel;
+    if (deg > 1 && mesh.axis_size(ax) == deg && orank > 0 &&
+        dim < (int64_t)orank) {
+      out.clear();
+      Choice c = base_choice("constrain");
+      if (t == "REPARTITION") {
+        c.out[0][dim] = ax;        // output constrained sharded on dim
+        c.in[0] = c.out[0];        // producer pays the reshard at the edge
+      } else if (t == "COMBINE") {
+        c.in[0][dim] = ax;         // consumes the sharded layout...
+        c.gather_bytes = (double)n.output_bytes(0);  // ...and gathers it
+        c.gather_k = (int)deg;
+      } else if (t == "REDUCTION") {
+        c.psum_bytes = (double)n.output_bytes(0);
+        c.psum_k = (int)deg;
+      }
+      // REPLICATE: in/out replicated — the reshard from a sharded producer
+      // is the broadcast cost, charged on the input edge
+      out.push_back(std::move(c));
+    }
   } else if (t == "EXPERTS" && mesh.ep > 1) {
     // expert parallelism: the stacked expert weights [E, ...] shard over
     // the 'expert' mesh axis; token dispatch/combine is the
@@ -457,6 +488,10 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     // ring attention K/V rotation; the backward rotates K/V and dK/dV
     double t = m.ring_time(c.ring_bytes, c.ring_k);
     nc.comm += training ? 3.0 * t : t;
+  }
+  if (c.gather_bytes > 0 && c.gather_k > 1) {
+    double t = m.allgather_time(c.gather_bytes, c.gather_k);
+    nc.comm += training ? 2.0 * t : t;  // bwd scatters the gradient back
   }
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
     nc.gradsync = m.allreduce_time(c.gradsync_bytes, c.gradsync_k);
