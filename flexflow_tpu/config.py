@@ -43,6 +43,7 @@ class FFConfig:
     enable_inplace_optimizations: bool = True
     search_overlap_backward_update: bool = False
     base_optimize_threshold: int = 10
+    enable_substitution: bool = True  # graph-rewrite outer loop (GraphXfer)
     substitution_json: Optional[str] = None
     memory_search: bool = False
     memory_threshold_mb: Optional[int] = None
@@ -128,6 +129,8 @@ class FFConfig:
                 self.base_optimize_threshold = int(take())
             elif a == "--substitution-json":
                 self.substitution_json = take()
+            elif a == "--disable-substitution":
+                self.enable_substitution = False
             elif a == "--memory-search":
                 self.memory_search = True
             elif a == "--memory-threshold":

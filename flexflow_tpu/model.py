@@ -515,8 +515,23 @@ class FFModel:
               and mesh is None):
             try:
                 mesh_axes, self.strategy, self.search_info = _unity.graph_optimize(
-                    nodes, self.machine_spec, cfg, n_dev, batch=batch0)
+                    nodes, self.machine_spec, cfg, n_dev, batch=batch0,
+                    final_ref=final_ref)
                 self.mesh = make_mesh(_math.prod(mesh_axes.values()), mesh_axes)
+                # the substitution engine may have rewritten the graph —
+                # run the rewritten node list (strategy is keyed to it)
+                if self.search_info.get("rewritten_nodes") is not None:
+                    nodes = self.search_info["rewritten_nodes"]
+                    if self.search_info.get("final_ref") is not None:
+                        final_ref = tuple(self.search_info["final_ref"])
+                    fnode = next(n for n in nodes if n.guid == final_ref[0])
+                    was_softmax = self._final_is_softmax
+                    self._final_is_softmax = (
+                        fnode.op.op_type == OperatorType.SOFTMAX)
+                    if was_softmax != self._final_is_softmax:
+                        self.metrics = Metrics(
+                            loss_type, list(metrics),
+                            preds_are_probs=self._final_is_softmax)
             except (RuntimeError, ImportError, OSError) as e:
                 print(f"[flexflow_tpu] search unavailable ({e}); "
                       f"falling back to data-parallel")

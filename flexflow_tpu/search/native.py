@@ -48,6 +48,9 @@ def get_lib():
         lib.ffs_optimize.restype = ctypes.c_void_p
         lib.ffs_simulate.argtypes = [ctypes.c_char_p]
         lib.ffs_simulate.restype = ctypes.c_void_p
+        if hasattr(lib, "ffs_list_rules"):
+            lib.ffs_list_rules.argtypes = [ctypes.c_char_p]
+            lib.ffs_list_rules.restype = ctypes.c_void_p
         lib.ffs_free.argtypes = [ctypes.c_void_p]
         lib.ffs_version.restype = ctypes.c_char_p
         _lib = lib
@@ -78,6 +81,12 @@ def native_optimize(request: Dict[str, Any]) -> Dict[str, Any]:
 
 def native_simulate(request: Dict[str, Any]) -> Dict[str, Any]:
     return _call("ffs_simulate", request)
+
+
+def native_list_rules(rules: Any) -> Dict[str, Any]:
+    """Parse a substitution rule corpus (reference RuleCollection JSON or
+    the native list form); returns {"count": N, "names": [...]}."""
+    return _call("ffs_list_rules", rules)
 
 
 def available() -> bool:

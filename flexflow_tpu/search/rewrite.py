@@ -1,0 +1,142 @@
+"""Replay native graph-rewrite traces on the Python OpNode graph.
+
+The native substitution engine (native/ffs_subst.hpp — analog of the
+reference's GraphXfer, src/runtime/substitution.cc:596) rewrites the
+search-side graph and reports a trace: per applied rule, the removed node
+guids, descriptors of the added nodes, and an output remap. This module
+replays that trace on the materialized OpNode list so the executor runs
+the rewritten graph — the counterpart of the reference applying the
+winning GraphXfer sequence to the PCG before execution
+(Graph::graph_optimize_task, src/runtime/graph.cc:2047).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.executor import OpNode
+from flexflow_tpu.ffconst import ActiMode, DataType, OperatorType
+from flexflow_tpu.layer import Layer
+from flexflow_tpu.ops import OpRegistry
+
+
+def external_input_ids(nodes) -> Dict[Tuple, int]:
+    """Stable negative guid per distinct non-op input ref, in first-seen
+    order — must match serialize_graph's numbering exactly."""
+    neg_of: Dict[Tuple, int] = {}
+    for node in nodes:
+        for ref in node.input_refs:
+            if ref[0] != "op" and tuple(ref) not in neg_of:
+                neg_of[tuple(ref)] = -2 - len(neg_of)
+    return neg_of
+
+
+def _props_from_attrs(op_type: OperatorType, attrs) -> dict:
+    """Map a native node descriptor's attrs to Layer properties."""
+    a = dict(attrs or {})
+    p: dict = {}
+    if op_type == OperatorType.LINEAR:
+        p["out_dim"] = int(a["out_dim"])
+        p["activation"] = ActiMode(int(a.get("activation", 0)))
+        p["use_bias"] = bool(a.get("use_bias", 1))
+    elif op_type == OperatorType.SPLIT:
+        p["sizes"] = tuple(int(s) for s in a["sizes"])
+        p["axis"] = int(a.get("axis", -1))
+    elif op_type == OperatorType.CONCAT:
+        p["axis"] = int(a.get("axis", 0))
+    elif op_type == OperatorType.REPARTITION:
+        p["dim"] = int(a.get("dim", 0))
+        p["degree"] = int(a.get("degree", 1))
+        # default axis assignment mirrors FFModel.repartition
+        p["axis"] = "data" if p["dim"] == 0 else "model"
+    elif op_type in (OperatorType.COMBINE, OperatorType.REDUCTION):
+        p["dim"] = int(a.get("dim", 0))
+        p["degree"] = int(a.get("degree", 1))
+    elif op_type == OperatorType.REPLICATE:
+        p["degree"] = int(a.get("degree", 1))
+    else:
+        # unary / elementwise / identity need nothing; pass through extras
+        for k, v in a.items():
+            p[k] = v
+    return p
+
+
+def apply_rewrites(nodes: List[OpNode], rewrites: List[dict],
+                   final_ref: Optional[Tuple[int, int]] = None,
+                   ) -> Tuple[List[OpNode], Optional[Tuple[int, int]]]:
+    """Apply the native rewrite trace to ``nodes``; returns the new node
+    list and the (guid, out_idx) the designated output moved to."""
+    if not rewrites:
+        return nodes, final_ref
+    nodes = list(nodes)
+    neg_of = external_input_ids(nodes)
+    ref_of_neg = {v: k for k, v in neg_of.items()}
+    # shapes: external inputs learned from their current consumers,
+    # op outputs from the producing op
+    ext_shape: Dict[int, Tuple[int, ...]] = {}
+    for node in nodes:
+        for slot, ref in enumerate(node.input_refs):
+            if ref[0] != "op":
+                ext_shape.setdefault(neg_of[tuple(ref)],
+                                     node.op.input_shapes[slot])
+    out_shape: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+    for node in nodes:
+        for i, s in enumerate(node.op.output_shapes):
+            out_shape[(node.guid, i)] = tuple(s)
+
+    fin = tuple(final_ref) if final_ref is not None else None
+    for entry in rewrites:
+        removed = {int(g) for g in entry["removed"]}
+        remap = {(int(a), int(b)): (int(c), int(d))
+                 for a, b, c, d in entry.get("output_remap", [])}
+        new_nodes: List[OpNode] = []
+        for desc in entry["added"]:
+            op_type = OperatorType[desc["type"]]
+            input_refs, in_shapes = [], []
+            for sg, si in desc["inputs"]:
+                sg, si = int(sg), int(si)
+                if sg >= 0:
+                    input_refs.append(("op", sg, si))
+                    in_shapes.append(out_shape[(sg, si)])
+                else:
+                    input_refs.append(ref_of_neg[sg])
+                    in_shapes.append(ext_shape[sg])
+            layer = Layer(op_type, desc["name"], [],
+                          data_type=DataType.FLOAT)
+            # adopt the native-assigned guid: the returned strategy and
+            # downstream edges are keyed by it
+            layer.guid = int(desc["guid"])
+            Layer._next_guid[0] = max(Layer._next_guid[0], layer.guid + 1)
+            layer.properties.update(
+                _props_from_attrs(op_type, desc.get("attrs")))
+            op = OpRegistry.create(layer, in_shapes)
+            got = [tuple(s) for s in op.output_shapes]
+            want = [tuple(int(d) for d in s) for s in desc["output_shapes"]]
+            if got != want:
+                raise RuntimeError(
+                    f"rewrite {entry['rule']}: node {desc['name']} shapes "
+                    f"{got} != native {want}")
+            for i, s in enumerate(got):
+                out_shape[(op.guid, i)] = s
+            new_nodes.append(OpNode(op, input_refs))
+
+        insert_at = min((i for i, n in enumerate(nodes)
+                         if n.guid in removed), default=len(nodes))
+        spliced: List[OpNode] = []
+        for i, n in enumerate(nodes):
+            if i == insert_at:
+                spliced.extend(new_nodes)
+            if n.guid in removed:
+                continue
+            n.input_refs = [
+                ("op",) + remap[(r[1], r[2])]
+                if (r[0] == "op" and (r[1], r[2]) in remap) else r
+                for r in n.input_refs
+            ]
+            spliced.append(n)
+        if insert_at == len(nodes):
+            spliced.extend(new_nodes)
+        nodes = spliced
+        if fin is not None and fin in remap:
+            fin = remap[fin]
+    return nodes, fin

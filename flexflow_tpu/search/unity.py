@@ -34,12 +34,34 @@ def _node_attrs(op) -> Dict[str, Any]:
     for k in ("num_heads", "groups", "axis", "out_dim", "k", "n",
               "n_experts", "hidden_size", "alpha"):
         v = getattr(op, k, None)
-        if isinstance(v, (int, float)):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
             attrs[k] = v
+    # the substitution engine matches on these (PM_* keys, ffs_subst.hpp)
+    act = getattr(op, "activation", None)
+    if act is not None and hasattr(act, "value"):
+        attrs["activation"] = int(act.value)
+    use_bias = getattr(op, "use_bias", None)
+    if isinstance(use_bias, bool):
+        attrs["use_bias"] = int(use_bias)
+    for prefix in ("repartition", "combine", "reduction"):
+        d = getattr(op, f"{prefix}_dim", None)
+        if d is not None:
+            attrs["dim"] = int(d)
+        g = getattr(op, f"{prefix}_degree", None)
+        if g is not None:
+            attrs["degree"] = int(g)
+    rdeg = getattr(op, "replicate_degree", None)
+    if rdeg is not None:
+        attrs["degree"] = int(rdeg)
+    sizes = getattr(op, "sizes", None)
+    if sizes is not None:
+        attrs["sizes"] = [int(s) for s in sizes]
     return attrs
 
 
 def serialize_graph(nodes) -> List[Dict[str, Any]]:
+    from flexflow_tpu.search.rewrite import external_input_ids
+    neg_of = external_input_ids(nodes)
     out = []
     for node in nodes:
         op = node.op
@@ -47,8 +69,9 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
         for ref in node.input_refs:
             if ref[0] == "op":
                 inputs.append([ref[1], ref[2]])
-            else:  # graph input staged from host — source guid -1
-                inputs.append([-1, 0])
+            else:  # graph input staged from host — unique negative guid so
+                   # substitution patterns can bind distinct externals
+                inputs.append([neg_of[tuple(ref)], 0])
         roles = [[r.value for r in rr] for rr in op.output_dim_roles()]
         out.append(dict(
             guid=op.guid,
@@ -113,8 +136,13 @@ def decode_strategy(resp: Dict[str, Any], nodes) -> Tuple[Dict[str, int], Strate
 def graph_optimize(nodes, machine_spec, config, num_devices: int,
                    measured: Optional[Dict[str, float]] = None,
                    batch: int = 0,
+                   final_ref: Optional[Tuple[int, int]] = None,
                    ) -> Tuple[Dict[str, int], Strategy, Dict[str, Any]]:
     """Run the native Unity search. Returns (mesh_axes, strategy, info).
+
+    When the substitution engine rewrites the graph, ``info`` carries
+    ``rewritten_nodes`` (the new OpNode list the strategy is keyed to) and
+    ``final_ref`` (where the designated output moved).
 
     Raises RuntimeError/ImportError when the native core is unavailable —
     callers fall back to the data-parallel default, matching the
@@ -122,17 +150,26 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
     """
     from flexflow_tpu.search.native import native_optimize
 
-    rules = []
+    rules: List[Any] = []
+    subst_rules = None
     if config.substitution_json:
         # an explicitly-requested rules file must fail loudly (ValueError is
         # not in compile()'s fallback set, so a bad path/contents aborts
         # instead of silently degrading to data-parallel)
         try:
             with open(config.substitution_json) as f:
-                rules = json.load(f).get("rules", [])
+                data = json.load(f)
         except OSError as e:
             raise ValueError(
                 f"--substitution-json {config.substitution_json}: {e}") from e
+        if isinstance(data, dict) and "rules" in data:
+            # native per-op choice filters ({"rules": [{op_type, allow}]})
+            rules = data["rules"]
+        else:
+            # graph-rewrite rule corpus: the reference RuleCollection
+            # format ({"rule": [...]}, substitution_loader.cc) or this
+            # repo's native list-of-rules form
+            subst_rules = data
     threshold = 0
     if config.memory_search and config.memory_threshold_mb:
         threshold = config.memory_threshold_mb * (1 << 20)
@@ -153,14 +190,29 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             seed=config.seed,
             batch=batch,
             rules=rules,
+            enable_substitution=getattr(config, "enable_substitution", True),
         ),
         measured=measured or {},
     )
+    if subst_rules is not None:
+        request["subst_rules"] = subst_rules
+    if final_ref is not None:
+        request["final"] = [int(final_ref[0]), int(final_ref[1])]
     resp = native_optimize(request)
-    mesh_axes, strategy = decode_strategy(resp, nodes)
+    new_nodes = nodes
+    new_final = final_ref
+    if resp.get("rewrites"):
+        from flexflow_tpu.search.rewrite import apply_rewrites
+        new_nodes, new_final = apply_rewrites(nodes, resp["rewrites"],
+                                              final_ref)
+    mesh_axes, strategy = decode_strategy(resp, new_nodes)
     info = dict(predicted_time=resp.get("predicted_time"),
                 predicted_memory=resp.get("predicted_memory"),
-                stats=resp.get("stats", {}))
+                stats=resp.get("stats", {}),
+                rewrites=resp.get("rewrites", []))
+    if new_nodes is not nodes:
+        info["rewritten_nodes"] = new_nodes
+        info["final_ref"] = new_final
     return mesh_axes, strategy, info
 
 

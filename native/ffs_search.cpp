@@ -24,9 +24,11 @@
 #include <cmath>
 #include <cstring>
 #include <map>
+#include <queue>
 #include <random>
 #include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "ffs_graph.hpp"
@@ -34,6 +36,7 @@
 #include "ffs_machine.hpp"
 #include "ffs_sim.hpp"
 #include "ffs_strategy.hpp"
+#include "ffs_subst.hpp"
 
 namespace ffsearch {
 namespace {
@@ -50,6 +53,8 @@ struct SearchConfig {
   int beam = 0;  // 0 = auto from budget
   unsigned seed = 0;
   int64_t batch = 0;  // global batch size; dp must divide it (0 = unconstrained)
+  bool enable_substitution = true;  // graph-rewrite outer loop
+  int subst_budget = 0;             // best-first expansions (0 = from budget)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
   static SearchConfig from_json(const Json& j) {
@@ -65,6 +70,9 @@ struct SearchConfig {
     c.beam = (int)j.get("beam").as_int(0);
     c.seed = (unsigned)j.get("seed").as_int(0);
     c.batch = j.get("batch").as_int(0);
+    c.enable_substitution = j.get("enable_substitution").as_bool(true);
+    c.subst_budget = (int)j.get("subst_budget").as_int(
+        std::max(1, std::min(c.budget, 16)));
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
       for (const Json& a : r.get("allow").items()) names.push_back(a.as_string());
@@ -331,32 +339,13 @@ Assignment mcmc_refine(const Graph& g, const std::vector<std::vector<Choice>>& c
   return best;
 }
 
-// ---- driver ---------------------------------------------------------------
+// ---- per-graph evaluation (mesh loop + DP [+ MCMC]) -----------------------
 
-Json spec_to_json(const Spec& s) {
-  Json arr = Json::array();
-  for (int8_t e : s)
-    arr.push_back(e == kData     ? Json("data")
-                  : e == kModel  ? Json("model")
-                  : e == kSeq    ? Json("seq")
-                  : e == kExpert ? Json("expert")
-                                 : Json());
-  return arr;
-}
-
-Json optimize(const Json& req) {
-  Graph g = Graph::from_json(req.get("nodes"));
-  MachineModel m = MachineModel::from_json(req.get("machine"));
-  SearchConfig cfg = SearchConfig::from_json(req.get("config"));
-  MeasuredCosts measured;
-  for (const auto& kv : req.get("measured").fields())
-    measured[kv.first] = kv.second.as_double();
-  double threshold = cfg.memory_threshold > 0 ? cfg.memory_threshold : m.hbm_cap;
-
-  // outer loop: mesh factorizations (MachineView enumeration analog) —
-  // now N-D: every (data, model, seq) factorization of the chip count.
-  // A 'seq' axis is only worth enumerating when the graph carries a
-  // sequence dim (roles mark it); expert axes arrive with MoE placement.
+// Outer mesh-shape enumeration (MachineView enumeration analog) — N-D:
+// every (data, model, seq, expert) factorization of the chip count legal
+// for this graph's seq extent / expert count.
+std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
+                                        const SearchConfig& cfg) {
   int64_t seq_extent = 0;
   int64_t num_experts = 0;
   for (const Node& n : g.nodes) {
@@ -390,52 +379,186 @@ Json optimize(const Json& req) {
       }
     }
   }
+  return meshes;
+}
 
-  double best_time = 1e30;
-  MeshShape best_mesh{N, 1};
-  Assignment best_assign;
-  std::vector<std::vector<Choice>> best_choices;
-  SimResult best_sim;
-  int64_t total_states = 0;
-  MCMCStats mcmc;
+struct GraphEval {
+  bool ok = false;
+  double time = 1e30;
+  MeshShape mesh{1, 1};
+  Assignment assign;
+  std::vector<std::vector<Choice>> choices;
+  SimResult sim;
+  int64_t states = 0;
+};
 
-  for (const MeshShape& mesh : meshes) {
+GraphEval eval_graph(const Graph& g, const MachineModel& m,
+                     const SearchConfig& cfg, double threshold,
+                     const MeasuredCosts& measured, bool refine,
+                     MCMCStats* mcmc) {
+  GraphEval ev;
+  for (const MeshShape& mesh : enumerate_meshes(g, m, cfg)) {
     auto choices = all_choices(g, mesh, cfg);
     DPResult dp = dp_with_memory(g, choices, mesh, m, cfg, threshold);
-    total_states += dp.states;
+    ev.states += dp.states;
     if (!dp.ok) continue;
     TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
                            cfg.opt_state_factor, &measured);
     Assignment a = dp.assign;
-    if (cfg.budget > 0)
-      a = mcmc_refine(g, choices, mesh, m, cfg, sim, a, threshold, &mcmc);
+    if (refine && cfg.budget > 0 && mcmc != nullptr)
+      a = mcmc_refine(g, choices, mesh, m, cfg, sim, a, threshold, mcmc);
     std::vector<Choice> cs;
     for (size_t i = 0; i < a.size(); ++i) cs.push_back(choices[i][a[i]]);
     SimResult sr = sim.simulate(cs);
     if (threshold > 0 && sr.memory > threshold) continue;
-    if (sr.iteration_time < best_time) {
-      best_time = sr.iteration_time;
-      best_mesh = mesh;
-      best_assign = a;
-      best_choices = choices;
-      best_sim = sr;
+    if (sr.iteration_time < ev.time) {
+      ev.time = sr.iteration_time;
+      ev.mesh = mesh;
+      ev.assign = a;
+      ev.choices = choices;
+      ev.sim = sr;
+      ev.ok = true;
+    }
+  }
+  return ev;
+}
+
+// ---- driver ---------------------------------------------------------------
+
+Json spec_to_json(const Spec& s) {
+  Json arr = Json::array();
+  for (int8_t e : s)
+    arr.push_back(e == kData     ? Json("data")
+                  : e == kModel  ? Json("model")
+                  : e == kSeq    ? Json("seq")
+                  : e == kExpert ? Json("expert")
+                                 : Json());
+  return arr;
+}
+
+Json optimize(const Json& req) {
+  Graph g0 = Graph::from_json(req.get("nodes"));
+  MachineModel m = MachineModel::from_json(req.get("machine"));
+  SearchConfig cfg = SearchConfig::from_json(req.get("config"));
+  MeasuredCosts measured;
+  for (const auto& kv : req.get("measured").fields())
+    measured[kv.first] = kv.second.as_double();
+  double threshold = cfg.memory_threshold > 0 ? cfg.memory_threshold : m.hbm_cap;
+
+  // user-designated model output: rewrites must never drop it unmapped
+  std::pair<int64_t, int> final_ref{-1, 0};
+  const Json& fj = req.get("final");
+  if (!fj.is_null())
+    final_ref = {fj[0].as_int(-1), static_cast<int>(fj[1].as_int(0))};
+
+  MCMCStats mcmc;
+  GraphEval best = eval_graph(g0, m, cfg, threshold, measured, false, nullptr);
+  int64_t total_states = best.states;
+  Graph best_g = g0;
+  std::vector<RewriteTraceEntry> best_trace;
+  std::pair<int64_t, int> best_fin = final_ref;
+
+  // ---- substitution best-first loop (base_optimize, substitution.cc:2229):
+  // pop the cheapest graph, apply every rule at every match, keep children
+  // within alpha of the incumbent. Rules = builtin generators
+  // (substitution.cc:1726-1860 analogs) + the request's rule corpus
+  // (reference substitutions/graph_subst_3_v2.json format supported).
+  std::vector<SubstRule> rules;
+  if (cfg.enable_substitution) {
+    rules = builtin_rules();
+    const Json& rj = req.get("subst_rules");
+    if (!rj.is_null())
+      for (SubstRule& r : parse_rules(rj)) rules.push_back(std::move(r));
+  }
+  int graphs_evaluated = 1, expansions = 0;
+  if (!rules.empty() && best.ok && !g0.nodes.empty()) {
+    struct Cand {
+      double cost;
+      Graph g;
+      std::vector<RewriteTraceEntry> trace;
+      std::pair<int64_t, int> fin;
+    };
+    int64_t next_guid = 0;
+    for (const Node& n : g0.nodes)
+      next_guid = std::max(next_guid, n.guid + 1);
+    auto cmp = [](const Cand& a, const Cand& b) { return a.cost > b.cost; };
+    std::priority_queue<Cand, std::vector<Cand>, decltype(cmp)> pq(cmp);
+    std::set<std::string> seen{graph_key(g0)};
+    pq.push({best.time, g0, {}, final_ref});
+    double alpha = 1.0 + std::max(0.0, cfg.alpha);
+    while (!pq.empty() && expansions < cfg.subst_budget) {
+      Cand cur = pq.top();
+      pq.pop();
+      if (cur.cost > best.time * alpha) break;
+      ++expansions;
+      for (const SubstRule& rule : rules) {
+        for (const Match& match : find_matches(cur.g, rule)) {
+          RewriteTraceEntry entry;
+          auto ng = apply_rule(cur.g, rule, match, &next_guid, &entry);
+          if (!ng) continue;
+          // chase the designated output through the rewrite; a rule that
+          // drops it unmapped would train on the wrong tensor — reject
+          std::pair<int64_t, int> fin = cur.fin;
+          if (fin.first >= 0) {
+            bool removed = std::find(entry.removed.begin(),
+                                     entry.removed.end(),
+                                     fin.first) != entry.removed.end();
+            bool remapped = false;
+            for (const auto& rm : entry.output_remap)
+              if (rm[0] == fin.first && rm[1] == fin.second) {
+                fin = {rm[2], static_cast<int>(rm[3])};
+                remapped = true;
+                break;
+              }
+            if (removed && !remapped) continue;
+          }
+          if (!seen.insert(graph_key(*ng)).second) continue;
+          GraphEval ev;
+          try {
+            ev = eval_graph(*ng, m, cfg, threshold, measured, false, nullptr);
+          } catch (const std::exception&) {
+            continue;  // e.g. a choice filter unsatisfiable on the rewrite
+          }
+          ++graphs_evaluated;
+          total_states += ev.states;
+          if (!ev.ok) continue;
+          std::vector<RewriteTraceEntry> trace = cur.trace;
+          trace.push_back(entry);
+          if (ev.time < best.time) {
+            best = ev;
+            best_g = *ng;
+            best_trace = trace;
+            best_fin = fin;
+          }
+          if (ev.time <= best.time * alpha && pq.size() < 256)
+            pq.push({ev.time, std::move(*ng), std::move(trace), fin});
+        }
+      }
     }
   }
 
+  // MCMC refinement on the winning graph (FFModel::mcmc_optimize analog)
+  if (cfg.budget > 0 && best.ok) {
+    GraphEval re = eval_graph(best_g, m, cfg, threshold, measured, true, &mcmc);
+    total_states += re.states;
+    if (re.ok && re.time <= best.time) best = re;
+  }
+
+  const Graph& g = best_g;
   Json out = Json::object();
-  if (best_assign.empty() && !g.nodes.empty()) {
+  if (!best.ok && !g.nodes.empty()) {
     out.set("error", "no feasible strategy (memory threshold too low?)");
     return out;
   }
   Json meshj = Json::object();
-  meshj.set("data", Json((int64_t)best_mesh.dp));
-  meshj.set("model", Json((int64_t)best_mesh.mp));
-  meshj.set("seq", Json((int64_t)best_mesh.sp));
-  meshj.set("expert", Json((int64_t)best_mesh.ep));
+  meshj.set("data", Json((int64_t)best.mesh.dp));
+  meshj.set("model", Json((int64_t)best.mesh.mp));
+  meshj.set("seq", Json((int64_t)best.mesh.sp));
+  meshj.set("expert", Json((int64_t)best.mesh.ep));
   out.set("mesh", meshj);
   Json ops = Json::object();
   for (size_t i = 0; i < g.nodes.size(); ++i) {
-    const Choice& c = best_choices[i][best_assign[i]];
+    const Choice& c = best.choices[i][best.assign[i]];
     Json oj = Json::object();
     oj.set("choice", Json(c.name));
     Json outs = Json::array();
@@ -450,17 +573,47 @@ Json optimize(const Json& req) {
     ops.set(std::to_string(g.nodes[i].guid), oj);
   }
   out.set("ops", ops);
-  out.set("predicted_time", Json(best_sim.iteration_time));
-  out.set("predicted_memory", Json(best_sim.memory));
+  // rewrite trace: Python replays this on its OpNode graph
+  Json rewrites = Json::array();
+  for (const RewriteTraceEntry& e : best_trace) {
+    Json ej = Json::object();
+    ej.set("rule", Json(e.rule));
+    Json rm = Json::array();
+    for (int64_t gd : e.removed) rm.push_back(Json(gd));
+    ej.set("removed", rm);
+    ej.set("added", e.added);
+    Json remap = Json::array();
+    for (const auto& r : e.output_remap) {
+      Json q = Json::array();
+      for (int64_t v : r) q.push_back(Json(v));
+      remap.push_back(q);
+    }
+    ej.set("output_remap", remap);
+    rewrites.push_back(ej);
+  }
+  out.set("rewrites", rewrites);
+  if (final_ref.first >= 0) {
+    Json fin = Json::array();
+    fin.push_back(Json(best_fin.first));
+    fin.push_back(Json((int64_t)best_fin.second));
+    out.set("final", fin);
+  }
+  out.set("predicted_time", Json(best.sim.iteration_time));
+  out.set("predicted_memory", Json(best.sim.memory));
   Json stats = Json::object();
   stats.set("states_explored", Json(total_states));
-  stats.set("mesh_candidates", Json((int64_t)meshes.size()));
+  stats.set("mesh_candidates",
+            Json((int64_t)enumerate_meshes(g, m, cfg).size()));
   stats.set("mcmc_iters", Json((int64_t)mcmc.iters));
   stats.set("mcmc_accepted", Json((int64_t)mcmc.accepted));
-  stats.set("fwd_time", Json(best_sim.fwd_time));
-  stats.set("bwd_time", Json(best_sim.bwd_time));
-  stats.set("comm_time", Json(best_sim.comm_time));
-  stats.set("gradsync_time", Json(best_sim.gradsync_time));
+  stats.set("rules_loaded", Json((int64_t)rules.size()));
+  stats.set("rewrites_applied", Json((int64_t)best_trace.size()));
+  stats.set("graphs_evaluated", Json((int64_t)graphs_evaluated));
+  stats.set("subst_expansions", Json((int64_t)expansions));
+  stats.set("fwd_time", Json(best.sim.fwd_time));
+  stats.set("bwd_time", Json(best.sim.bwd_time));
+  stats.set("comm_time", Json(best.sim.comm_time));
+  stats.set("gradsync_time", Json(best.sim.gradsync_time));
   out.set("stats", stats);
   return out;
 }
@@ -529,6 +682,28 @@ char* ffs_optimize(const char* request_json) {
   try {
     ffsearch::Json req = ffsearch::Json::parse(request_json);
     return ffsearch::dup_string(ffsearch::optimize(req).dump());
+  } catch (const std::exception& e) {
+    ffsearch::Json err = ffsearch::Json::object();
+    err.set("error", ffsearch::Json(std::string(e.what())));
+    return ffsearch::dup_string(err.dump());
+  }
+}
+
+// Parse a substitution rule corpus (reference RuleCollection format,
+// substitution_loader.cc, or this repo's native list) and report what
+// loaded: {"count": N, "names": [...]}. Used by --substitution-json
+// validation and tests.
+char* ffs_list_rules(const char* rules_json) {
+  try {
+    ffsearch::Json rj = ffsearch::Json::parse(rules_json);
+    std::vector<ffsearch::SubstRule> rules = ffsearch::parse_rules(rj);
+    ffsearch::Json out = ffsearch::Json::object();
+    out.set("count", ffsearch::Json((int64_t)rules.size()));
+    ffsearch::Json names = ffsearch::Json::array();
+    for (size_t i = 0; i < rules.size() && i < 64; ++i)
+      names.push_back(ffsearch::Json(rules[i].name));
+    out.set("names", names);
+    return ffsearch::dup_string(out.dump());
   } catch (const std::exception& e) {
     ffsearch::Json err = ffsearch::Json::object();
     err.set("error", ffsearch::Json(std::string(e.what())));

@@ -356,10 +356,13 @@ inline std::optional<Graph> apply_rule(const Graph& g, const SubstRule& rule,
                                                    {-1, 0});
   auto dst_ref = [&](int op, int ts) { return dst_out_ref[op * 4 + ts]; };
 
+  // sentinel for "dst uses an external the src pattern never bound" — must
+  // not collide with real graph-input ids (small negative guids)
+  constexpr int64_t kUnbound = INT64_MIN;
   auto ext_ref = [&](int op_id, int ts_id) -> std::pair<int64_t, int> {
     auto it = match.ext.find(op_id * 1000 + ts_id);
     if (it != match.ext.end()) return it->second;
-    return {-2, 0};  // unbound external: dst uses an input src didn't touch
+    return {kUnbound, 0};
   };
 
   auto para_val = [&](const SubstOp& op, const char* key,
@@ -418,7 +421,7 @@ inline std::optional<Graph> apply_rule(const Graph& g, const SubstRule& rule,
     for (auto [op_id, ts_id] : dop.inputs) {
       std::pair<int64_t, int> ref =
           op_id >= 0 ? dst_ref(op_id, ts_id) : ext_ref(op_id, ts_id);
-      if (ref.first == -2) return std::nullopt;
+      if (ref.first == kUnbound) return std::nullopt;
       n.inputs.push_back({ref.first, ref.second});
       auto shp = shape_of(ref);
       if (!shp) return std::nullopt;
