@@ -65,10 +65,27 @@ def apply_rewrites(nodes: List[OpNode], rewrites: List[dict],
                    final_ref: Optional[Tuple[int, int]] = None,
                    ) -> Tuple[List[OpNode], Optional[Tuple[int, int]]]:
     """Apply the native rewrite trace to ``nodes``; returns the new node
-    list and the (guid, out_idx) the designated output moved to."""
+    list and the (guid, out_idx) the designated output moved to.
+
+    The caller's nodes are never mutated: a failed replay (shape
+    cross-check, malformed trace) leaves them intact so the data-parallel
+    fallback in FFModel.compile runs on the original graph. All trace
+    errors surface as RuntimeError — the fallback's catch type.
+    """
     if not rewrites:
         return nodes, final_ref
-    nodes = list(nodes)
+    try:
+        return _apply_rewrites(nodes, rewrites, final_ref)
+    except RuntimeError:
+        raise
+    except Exception as e:  # malformed trace: KeyError, ValueError, ...
+        raise RuntimeError(f"rewrite trace replay failed: {e!r}") from e
+
+
+def _apply_rewrites(nodes, rewrites, final_ref):
+    # work on wrapper copies so the caller's OpNodes stay untouched even
+    # when a later trace entry fails mid-replay
+    nodes = [OpNode(n.op, list(n.input_refs)) for n in nodes]
     neg_of = external_input_ids(nodes)
     ref_of_neg = {v: k for k, v in neg_of.items()}
     # shapes: external inputs learned from their current consumers,

@@ -44,6 +44,7 @@ struct MachineModel {
     m.dcn_latency = j.get("dcn_latency").as_double(m.dcn_latency);
     m.num_slices = static_cast<int>(j.get("num_slices").as_int(1));
     m.mxu_efficiency = j.get("mxu_efficiency").as_double(m.mxu_efficiency);
+    m.min_op_time = j.get("min_op_time").as_double(m.min_op_time);
     return m;
   }
 
@@ -89,11 +90,14 @@ struct MachineModel {
   }
 
   // Roofline: time for `flop` FLOPs touching `bytes` of HBM on one chip.
-  // `dtype_size` > 2 (f32) halves MXU throughput.
+  // `dtype_size` > 2 (f32) halves MXU throughput. `min_op_time` is charged
+  // additively as per-kernel dispatch overhead — fusing two kernels into
+  // one (e.g. two narrow matmuls into a wide one) saves a dispatch, which
+  // the reference's measured per-op costs capture implicitly
+  // (src/runtime/model.cu:38-74) and a pure roofline would miss.
   double compute_time(double flop, double bytes, int dtype_size = 2) const {
     double peak = flops * mxu_efficiency * (dtype_size <= 2 ? 1.0 : 0.5);
-    double t = std::max(flop / peak, bytes / hbm_bw);
-    return std::max(t, min_op_time);
+    return std::max(flop / peak, bytes / hbm_bw) + min_op_time;
   }
 };
 

@@ -452,6 +452,9 @@ Json optimize(const Json& req) {
     final_ref = {fj[0].as_int(-1), static_cast<int>(fj[1].as_int(0))};
 
   MCMCStats mcmc;
+  // "mesh shapes searched" means the original graph's candidate set; the
+  // winning (possibly rewritten) graph may legalize a different set
+  int64_t mesh_candidates = (int64_t)enumerate_meshes(g0, m, cfg).size();
   GraphEval best = eval_graph(g0, m, cfg, threshold, measured, false, nullptr);
   int64_t total_states = best.states;
   Graph best_g = g0;
@@ -602,8 +605,7 @@ Json optimize(const Json& req) {
   out.set("predicted_memory", Json(best.sim.memory));
   Json stats = Json::object();
   stats.set("states_explored", Json(total_states));
-  stats.set("mesh_candidates",
-            Json((int64_t)enumerate_meshes(g, m, cfg).size()));
+  stats.set("mesh_candidates", Json(mesh_candidates));
   stats.set("mcmc_iters", Json((int64_t)mcmc.iters));
   stats.set("mcmc_accepted", Json((int64_t)mcmc.accepted));
   stats.set("rules_loaded", Json((int64_t)rules.size()));

@@ -472,9 +472,20 @@ struct NodeCost {
   double total() const { return fwd + bwd + comm + gradsync; }
 };
 
+// Layout-only ops XLA fuses into their producer/consumer on TPU: a slice,
+// concat or reshape of a matmul output compiles to index arithmetic inside
+// the neighboring fused kernel, not a standalone HBM round-trip. Charging
+// them real traffic would make kernel-fusion rewrites (one wide matmul +
+// split vs two narrow matmuls) look like losses when on hardware they win.
+inline bool is_view_op(const std::string& t) {
+  return t == "SPLIT" || t == "CONCAT" || t == "RESHAPE" || t == "FLAT" ||
+         t == "IDENTITY" || t == "NOOP" || t == "INPUT";
+}
+
 inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
                           const MachineModel& m, bool training) {
   NodeCost nc;
+  if (is_view_op(n.type)) return nc;  // fused away by XLA: free
   double div = std::max(1.0, c.work_div);
   double flop = n.fwd_flops / div;
   double bytes = (double)n.total_io_bytes() / div;
@@ -502,6 +513,7 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
 // state) + sharded activations (kept for backward).
 inline double node_memory(const Node& n, const Choice& c, const MeshShape& mesh,
                           double opt_state_factor) {
+  if (is_view_op(n.type)) return 0.0;  // fused away: materializes nothing
   double mem = detail::sharded_param_bytes(n, c, mesh) * (1.0 + opt_state_factor);
   for (size_t i = 0; i < n.output_shapes.size(); ++i) {
     int k = i < c.out.size() ? shards_of(c.out[i], mesh) : 1;
