@@ -10,6 +10,7 @@ into PartitionSpecs, and provide strategy file export/import
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -100,10 +101,12 @@ def machine_to_json(spec, num_devices: int) -> Dict[str, Any]:
         dcn_bw=spec.dcn_bw,
         dcn_latency=spec.dcn_latency,
         num_slices=spec.num_slices,
+        mxu_efficiency=getattr(spec, "mxu_efficiency", 0.55),
+        min_op_time=getattr(spec, "min_op_time", 5e-7),
     )
 
 
-def _entries_to_spec(entries: List[Optional[str]]) -> P:
+def _entries_to_spec(entries: List[Optional[Any]]) -> P:
     while entries and entries[-1] is None:
         entries = entries[:-1]
     return P(*entries)
@@ -119,14 +122,20 @@ def decode_strategy(resp: Dict[str, Any], nodes) -> Tuple[Dict[str, int], Strate
         oj = resp["ops"].get(str(node.op.guid))
         if oj is None:
             continue
+        def _entry(e):
+            # "data+model": 2-D sample partition -> a PartitionSpec tuple
+            # entry over both axes (sample parallelism, config.h:134)
+            if e == "data+model":
+                axes = tuple(a for a in ("data", "model") if a in valid)
+                return axes if len(axes) > 1 else (axes[0] if axes else None)
+            return e if e in valid else None
+
         outs = []
         for entries in oj["outputs"]:
-            entries = [e if e in valid else None for e in entries]
-            outs.append(_entries_to_spec(entries))
+            outs.append(_entries_to_spec([_entry(e) for e in entries]))
         params = {}
         for pname, entries in oj.get("params", {}).items():
-            entries = [e if e in valid else None for e in entries]
-            params[pname] = _entries_to_spec(entries)
+            params[pname] = _entries_to_spec([_entry(e) for e in entries])
         st = OpStrategy(output_specs=outs, param_specs=params)
         st.choice = oj.get("choice")
         strategy[node.op.guid] = st
@@ -152,6 +161,20 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
 
     rules: List[Any] = []
     subst_rules = None
+    if (not config.substitution_json
+            and getattr(config, "enable_substitution", True)):
+        # default shipped corpus (analog of the reference loading
+        # substitutions/graph_subst_3_v2.json at search start)
+        default = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "substitutions", "ffs_subst_v1.json")
+        if os.path.exists(default):
+            try:
+                with open(default) as f:
+                    subst_rules = json.load(f)
+            except (OSError, ValueError):
+                subst_rules = None
     if config.substitution_json:
         # an explicitly-requested rules file must fail loudly (ValueError is
         # not in compile()'s fallback set, so a bad path/contents aborts
@@ -191,6 +214,11 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             batch=batch,
             rules=rules,
             enable_substitution=getattr(config, "enable_substitution", True),
+            enable_sample_parallel=getattr(config, "enable_sample_parallel",
+                                           True),
+            # optimizer-state copies (0 SGD / 1 momentum / 2 Adam), set by
+            # FFModel.compile from the actual optimizer
+            opt_state_factor=getattr(config, "opt_state_factor", 2.0),
         ),
         measured=measured or {},
     )
@@ -198,7 +226,25 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
         request["subst_rules"] = subst_rules
     if final_ref is not None:
         request["final"] = [int(final_ref[0]), int(final_ref[1])]
-    resp = native_optimize(request)
+    # search introspection (reference's RecursiveLogger around the DP —
+    # graph.cc's get_logger() tree); on by --profiling or FF_LOG_SEARCH
+    from flexflow_tpu.utils.logger import RecursiveLogger
+    log = RecursiveLogger("unity", enabled=bool(
+        getattr(config, "profiling", False)
+        or os.environ.get("FF_LOG_SEARCH")))
+    with log.enter(f"graph_optimize: {len(nodes)} ops on "
+                   f"{num_devices} devices"):
+        resp = native_optimize(request)
+        stats = resp.get("stats", {})
+        with log.enter(f"searched {stats.get('mesh_candidates')} meshes, "
+                       f"{stats.get('states_explored')} DP states, "
+                       f"{stats.get('rules_loaded')} rules"):
+            for rw in resp.get("rewrites", []):
+                log.info(f"rewrite {rw['rule']}: removed {rw['removed']}, "
+                         f"added {[a['name'] for a in rw['added']]}")
+        log.info(f"best mesh {resp.get('mesh')} predicted "
+                 f"{resp.get('predicted_time', 0) * 1e3:.3f} ms "
+                 f"({stats.get('rewrites_applied', 0)} rewrites)")
     new_nodes = nodes
     new_final = final_ref
     if resp.get("rewrites"):

@@ -89,6 +89,27 @@ struct MachineModel {
            2.0 * (num_slices - 1) / num_slices * bytes / dcn_bw;
   }
 
+  int chips_per_slice() const {
+    return std::max(1, num_devices / std::max(1, num_slices));
+  }
+
+  // Hierarchical all-reduce of `bytes` over `k` chips spanning `slices`
+  // ICI domains: reduce-scatter+all-gather inside each slice over ICI,
+  // cross-slice all-reduce of each chip's 1/k_inner shard over DCN — the
+  // standard multislice gradient sync (NetworkedMachineModel's role,
+  // reference simulator.h:515, re-expressed for the TPU slice topology).
+  double hier_allreduce_time(double bytes, int k, int slices) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    slices = std::max(1, std::min(slices, num_slices));
+    if (slices <= 1) return allreduce_time(bytes, k);
+    int k_inner = std::max(1, k / slices);
+    double t = allreduce_time(bytes, k_inner);
+    double shard = bytes / k_inner;
+    t += dcn_latency * (slices - 1) +
+         2.0 * (slices - 1) / slices * shard / dcn_bw;
+    return t;
+  }
+
   // Roofline: time for `flop` FLOPs touching `bytes` of HBM on one chip.
   // `dtype_size` > 2 (f32) halves MXU throughput. `min_op_time` is charged
   // additively as per-kernel dispatch overhead — fusing two kernels into

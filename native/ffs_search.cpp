@@ -54,6 +54,7 @@ struct SearchConfig {
   unsigned seed = 0;
   int64_t batch = 0;  // global batch size; dp must divide it (0 = unconstrained)
   bool enable_substitution = true;  // graph-rewrite outer loop
+  bool enable_sample_parallel = true;  // 2-D batch partition (config.h:134)
   int subst_budget = 0;             // best-first expansions (0 = from budget)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
@@ -71,6 +72,7 @@ struct SearchConfig {
     c.seed = (unsigned)j.get("seed").as_int(0);
     c.batch = j.get("batch").as_int(0);
     c.enable_substitution = j.get("enable_substitution").as_bool(true);
+    c.enable_sample_parallel = j.get("enable_sample_parallel").as_bool(true);
     c.subst_budget = (int)j.get("subst_budget").as_int(
         std::max(1, std::min(c.budget, 16)));
     for (const Json& r : j.get("rules").items()) {
@@ -97,8 +99,11 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                              const SearchConfig& cfg) {
   std::vector<std::vector<Choice>> out;
   for (const Node& n : g.nodes) {
-    auto cs = enumerate_choices(n, mesh, cfg.enable_parameter_parallel &&
-                                             !cfg.only_data_parallel);
+    auto cs = enumerate_choices(n, mesh,
+                                cfg.enable_parameter_parallel &&
+                                    !cfg.only_data_parallel,
+                                cfg.enable_sample_parallel &&
+                                    !cfg.only_data_parallel);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -139,7 +144,8 @@ struct DPState {
 
 DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& choices,
                      const MeshShape& mesh, const MachineModel& m,
-                     const SearchConfig& cfg, double lambda) {
+                     const SearchConfig& cfg, double lambda,
+                     const MeasuredCosts* measured) {
   const size_t N = g.nodes.size();
   // remaining-use counts per (guid, out_idx)
   std::map<std::pair<int64_t, int>, int> uses;
@@ -205,7 +211,7 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
                                (double)g.nodes[pi].output_bytes(n.inputs[slot].src_idx),
                                mesh, m);
         }
-        NodeCost nc = node_cost(n, c, mesh, m, cfg.training);
+        NodeCost nc = node_cost(n, c, mesh, m, cfg.training, measured);
         cost += nc.total();
         double mem = node_memory(n, c, mesh, cfg.opt_state_factor);
         cost += lambda * mem;
@@ -258,14 +264,15 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
 // Memory-aware lambda binary search (graph.cc:1883 try_one_lambda loop).
 DPResult dp_with_memory(const Graph& g, const std::vector<std::vector<Choice>>& choices,
                         const MeshShape& mesh, const MachineModel& m,
-                        const SearchConfig& cfg, double threshold) {
-  DPResult r0 = frontier_dp(g, choices, mesh, m, cfg, 0.0);
+                        const SearchConfig& cfg, double threshold,
+                        const MeasuredCosts* measured) {
+  DPResult r0 = frontier_dp(g, choices, mesh, m, cfg, 0.0, measured);
   if (!r0.ok || threshold <= 0 || r0.memory <= threshold) return r0;
   // find a lambda that fits: double until feasible, then 10-iter bisect
   double lo = 0.0, hi = r0.cost / std::max(1.0, r0.memory);
   DPResult fit;
   for (int it = 0; it < 20; ++it) {
-    fit = frontier_dp(g, choices, mesh, m, cfg, hi);
+    fit = frontier_dp(g, choices, mesh, m, cfg, hi, measured);
     r0.states += fit.states;
     if (fit.ok && fit.memory <= threshold) break;
     lo = hi;
@@ -274,7 +281,7 @@ DPResult dp_with_memory(const Graph& g, const std::vector<std::vector<Choice>>& 
   if (!(fit.ok && fit.memory <= threshold)) { r0.ok = false; return r0; }
   for (int it = 0; it < 10; ++it) {
     double mid = 0.5 * (lo + hi);
-    DPResult rm = frontier_dp(g, choices, mesh, m, cfg, mid);
+    DPResult rm = frontier_dp(g, choices, mesh, m, cfg, mid, measured);
     r0.states += rm.states;
     if (rm.ok && rm.memory <= threshold) {
       hi = mid;
@@ -375,6 +382,14 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
         int dp = N / mp / sp / ep;
         // the host stages the batch sharded over 'data': dp must divide it
         if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+        // multislice: model/seq/expert collectives are latency-bound and
+        // must stay inside one ICI domain; only the data (gradient) axis
+        // may span slices over DCN (priced by hier_allreduce_time)
+        if (m.num_slices > 1) {
+          int inner = mp * sp * ep;
+          if (inner > m.chips_per_slice() || m.chips_per_slice() % inner)
+            continue;
+        }
         meshes.push_back({dp, mp, sp, ep});
       }
     }
@@ -399,7 +414,7 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
   GraphEval ev;
   for (const MeshShape& mesh : enumerate_meshes(g, m, cfg)) {
     auto choices = all_choices(g, mesh, cfg);
-    DPResult dp = dp_with_memory(g, choices, mesh, m, cfg, threshold);
+    DPResult dp = dp_with_memory(g, choices, mesh, m, cfg, threshold, &measured);
     ev.states += dp.states;
     if (!dp.ok) continue;
     TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
@@ -428,11 +443,12 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
 Json spec_to_json(const Spec& s) {
   Json arr = Json::array();
   for (int8_t e : s)
-    arr.push_back(e == kData     ? Json("data")
-                  : e == kModel  ? Json("model")
-                  : e == kSeq    ? Json("seq")
-                  : e == kExpert ? Json("expert")
-                                 : Json());
+    arr.push_back(e == kData      ? Json("data")
+                  : e == kModel   ? Json("model")
+                  : e == kSeq     ? Json("seq")
+                  : e == kExpert  ? Json("expert")
+                  : e == kDataModel ? Json("data+model")
+                                  : Json());
   return arr;
 }
 
@@ -642,8 +658,11 @@ Json simulate_only(const Json& req) {
                                "' for op " + std::to_string(g.nodes[i].guid));
     cs.push_back(*pick);
   }
+  MeasuredCosts measured;
+  for (const auto& kv : req.get("measured").fields())
+    measured[kv.first] = kv.second.as_double();
   TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
-                         cfg.opt_state_factor, nullptr);
+                         cfg.opt_state_factor, &measured);
   SimResult r = sim.simulate(cs);
   Json out = Json::object();
   out.set("iteration_time", Json(r.iteration_time));

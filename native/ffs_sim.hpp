@@ -66,14 +66,7 @@ class TaskgraphSimulator {
     for (size_t i = 0; i < N; ++i) {
       const Node& n = g_.nodes[i];
       const Choice& c = assign[i];
-      NodeCost nc = node_cost(n, c, mesh_, m_, training_);
-      if (measured_) {
-        auto it = measured_->find(std::to_string(n.guid) + ":" + c.name);
-        if (it != measured_->end()) {
-          nc.fwd = it->second / std::max(1.0, c.work_div);
-          nc.bwd = training_ ? 2.0 * nc.fwd : 0.0;
-        }
-      }
+      NodeCost nc = node_cost(n, c, mesh_, m_, training_, measured_);
       std::vector<int> deps;
       for (size_t slot = 0; slot < n.inputs.size(); ++slot) {
         const EdgeRef& e = n.inputs[slot];
@@ -125,7 +118,7 @@ class TaskgraphSimulator {
       for (int i = static_cast<int>(N) - 1; i >= 0; --i) {
         const Node& n = g_.nodes[i];
         const Choice& c = assign[i];
-        NodeCost nc = node_cost(n, c, mesh_, m_, true);
+        NodeCost nc = node_cost(n, c, mesh_, m_, true, measured_);
         std::vector<int> deps = {fwd_id[i]};
         auto it = g_.consumers.find(n.guid);
         if (it != g_.consumers.end())
@@ -143,10 +136,18 @@ class TaskgraphSimulator {
       // ---- per-parameter gradient sync + optimizer update ----
       std::vector<int> sync_ids;
       int last_bwd = N > 0 ? bwd_id[0] : -1;
-      for (size_t i = 0; i < N; ++i) {
+      // reverse node order = backward-completion order: the scheduler
+      // below assigns the comm stream in task-creation order, and a real
+      // runtime fires each parameter's all-reduce the moment its backward
+      // finishes (head layers first) — creation order must match or the
+      // simulated syncs all queue behind the one that is ready last
+      int spans = slices_spanned(mesh_, m_);
+      for (size_t j = 0; j < N; ++j) {
+        size_t i = N - 1 - j;
         const Choice& c = assign[i];
         if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {
-          double t = m_.allreduce_time(c.gradsync_bytes, c.gradsync_k);
+          double t = m_.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
+                                            spans);
           std::vector<int> deps = {bwd_id[i]};
           if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
           SimTask st{SimTask::Kind::GradSync, (int)i, t, deps};
@@ -154,13 +155,24 @@ class TaskgraphSimulator {
           res.gradsync_time += t;
         }
       }
+      // optimizer update traffic: read p + read g + write p (3x params)
+      // plus read+write of each optimizer-state copy (2x per copy;
+      // opt_state_factor = state copies: 0 plain SGD, 1 momentum, 2 Adam).
+      // Bandwidth: the measured update-triad rate when profiled
+      // ("__update_bw__" — elementwise updates run well below the
+      // datasheet HBM figure), else the analytic hbm_bw.
+      double upd_bw = m_.hbm_bw;
+      if (measured_) {
+        auto it = measured_->find("__update_bw__");
+        if (it != measured_->end() && it->second > 0) upd_bw = it->second;
+      }
       double upd_bytes = 0;
       for (size_t i = 0; i < N; ++i)
         upd_bytes += (double)g_.nodes[i].param_bytes() *
-                     (1.0 + opt_state_factor_);
+                     (3.0 + 2.0 * opt_state_factor_);
       std::vector<int> deps = sync_ids;
       if (last_bwd >= 0) deps.push_back(last_bwd);
-      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / m_.hbm_bw, deps};
+      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / upd_bw, deps};
       add(std::move(ut));
     }
 
@@ -179,6 +191,12 @@ class TaskgraphSimulator {
       makespan = std::max(makespan, t.finish);
     }
     res.iteration_time = makespan;
+    if (measured_) {
+      // fixed per-step dispatch/runtime cost measured on the live device
+      // (program launch + host runtime; large on tunneled devices)
+      auto it = measured_->find("__step_overhead__");
+      if (it != measured_->end()) res.iteration_time += it->second;
+    }
     res.tasks = std::move(tasks);
     return res;
   }

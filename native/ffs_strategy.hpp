@@ -39,6 +39,11 @@ constexpr int8_t kData = 0;
 constexpr int8_t kModel = 1;
 constexpr int8_t kSeq = 2;
 constexpr int8_t kExpert = 3;
+// sample parallelism (reference config.h:134 enable_sample_parallel): the
+// sample dim sharded over BOTH the data and model axes jointly — a 2-D
+// partition of the batch, used when an op's weights are replicated and
+// the model axis would otherwise sit idle for it
+constexpr int8_t kDataModel = 4;
 
 using Spec = std::vector<int8_t>;
 
@@ -53,6 +58,7 @@ struct MeshShape {
       case kModel: return mp;
       case kSeq: return sp;
       case kExpert: return ep;
+      case kDataModel: return dp * mp;
       default: return 1;
     }
   }
@@ -60,6 +66,16 @@ struct MeshShape {
 };
 
 inline Spec rep_spec(size_t rank) { return Spec(rank, kRep); }
+
+// How many ICI slices the data axis spans. Mesh legality (enumerate_meshes)
+// keeps model/seq/expert inside one slice — their latency-sensitive
+// collectives ride ICI — so only the gradient ring (data axis) crosses DCN.
+inline int slices_spanned(const MeshShape& mesh, const MachineModel& m) {
+  if (m.num_slices <= 1) return 1;
+  int inner = mesh.mp * mesh.sp * mesh.ep;
+  int dp_in_slice = std::max(1, m.chips_per_slice() / inner);
+  return std::max(1, mesh.dp / dp_in_slice);
+}
 
 inline int shards_of(const Spec& s, const MeshShape& mesh) {
   int k = 1;
@@ -94,10 +110,19 @@ inline double reshard_cost(const Spec& a, const Spec& b, double global_bytes,
   if (a == b) return 0.0;
   int ka = shards_of(a, mesh), kb = shards_of(b, mesh);
   if (ka <= 1 && kb <= 1) return 0.0;
-  // (dim, axis) pairs
+  // (dim, base axis) pairs; the joint kDataModel entry expands into its
+  // base axes so data ⊂ data+model reads as pure additional slicing
   std::set<std::pair<int, int8_t>> sa, sb;
-  for (size_t i = 0; i < a.size(); ++i) if (a[i] >= 0) sa.insert({(int)i, a[i]});
-  for (size_t i = 0; i < b.size(); ++i) if (b[i] >= 0) sb.insert({(int)i, b[i]});
+  auto expand = [](std::set<std::pair<int, int8_t>>& s, int i, int8_t ax) {
+    if (ax == kDataModel) {
+      s.insert({i, kData});
+      s.insert({i, kModel});
+    } else {
+      s.insert({i, ax});
+    }
+  };
+  for (size_t i = 0; i < a.size(); ++i) if (a[i] >= 0) expand(sa, (int)i, a[i]);
+  for (size_t i = 0; i < b.size(); ++i) if (b[i] >= 0) expand(sb, (int)i, b[i]);
   bool a_in_b = std::includes(sb.begin(), sb.end(), sa.begin(), sa.end());
   if (a_in_b) return 0.0;  // pure additional slicing: local
   bool b_in_a = std::includes(sa.begin(), sa.end(), sb.begin(), sb.end());
@@ -155,9 +180,11 @@ inline double sharded_param_bytes(const Node& n, const Choice& c,
 
 // Enumerate the legal sharding choices of `n` on mesh (dp, mp).
 // `enable_pp` gates parameter/attribute parallelism
-// (--enable-parameter-parallel, reference model.cc:3612).
+// (--enable-parameter-parallel, reference model.cc:3612); `enable_sp2`
+// gates the 2-D sample partition (--enable-sample-parallel, config.h:134).
 inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mesh,
-                                             bool enable_pp) {
+                                             bool enable_pp,
+                                             bool enable_sp2 = true) {
   using detail::div_ok;
   using detail::dp_spec;
   const int dp = mesh.dp, mp = mesh.mp;
@@ -196,6 +223,27 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     return c;
   };
   if (dp_legal) out.push_back(make_dp());
+
+  // 2-D sample partition: batch over data x model jointly. Worth it for
+  // ops whose params are replicated (their gradient ring widens to
+  // dp*mp, but the work divides by dp*mp instead of dp while the model
+  // axis would otherwise idle through this op).
+  if (enable_sp2 && sample0 && mesh.mp > 1 && dp > 0 &&
+      detail::div_ok(batch, (int64_t)dp * mesh.mp)) {
+    Choice c = base_choice("sample2");
+    for (size_t i = 0; i < n.output_shapes.size(); ++i) {
+      const Shape& os = n.output_shapes[i];
+      if (!os.empty() && os[0] == batch) c.out[i][0] = kDataModel;
+    }
+    for (size_t i = 0; i < n.input_shapes.size(); ++i) {
+      const Shape& is = n.input_shapes[i];
+      if (!is.empty() && is[0] == batch) c.in[i][0] = kDataModel;
+    }
+    c.work_div = (double)dp * mesh.mp;
+    c.gradsync_bytes = detail::pbytes(n);
+    c.gradsync_k = dp * mesh.mp;
+    out.push_back(std::move(c));
+  }
 
   const bool pp = enable_pp && mp > 1;
   const std::string& t = n.type;
@@ -482,15 +530,34 @@ inline bool is_view_op(const std::string& t) {
          t == "IDENTITY" || t == "NOOP" || t == "INPUT";
 }
 
+// Per-node forward/backward time. When a measured-cost table is supplied
+// (real-chip microbenchmarks, the analog of the reference's
+// measure_operator_cost cache, src/runtime/model.cu:38-74 +
+// simulator.h:750-752), entries "<guid>:fwd" / "<guid>:bwd" override the
+// analytic roofline; sharded work scales as measured/work_div. Backward is
+// measured separately — not assumed 2x forward — when the profiler provides
+// it.
 inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
-                          const MachineModel& m, bool training) {
+                          const MachineModel& m, bool training,
+                          const MeasuredCosts* measured = nullptr) {
   NodeCost nc;
   if (is_view_op(n.type)) return nc;  // fused away by XLA: free
   double div = std::max(1.0, c.work_div);
+  const double* mfwd = nullptr;
+  const double* mbwd = nullptr;
+  if (measured != nullptr) {
+    auto itf = measured->find(std::to_string(n.guid) + ":fwd");
+    if (itf != measured->end()) mfwd = &itf->second;
+    auto itb = measured->find(std::to_string(n.guid) + ":bwd");
+    if (itb != measured->end()) mbwd = &itb->second;
+  }
   double flop = n.fwd_flops / div;
   double bytes = (double)n.total_io_bytes() / div;
-  nc.fwd = m.compute_time(flop, bytes, n.dtype_size);
-  if (training) nc.bwd = 2.0 * nc.fwd;  // dX + dW passes
+  nc.fwd = mfwd ? std::max(*mfwd / div, m.min_op_time)
+                : m.compute_time(flop, bytes, n.dtype_size);
+  if (training)
+    nc.bwd = mbwd ? std::max(*mbwd / div, m.min_op_time)
+                  : 2.0 * nc.fwd;  // dX + dW passes
   if (c.psum_bytes > 0 && c.psum_k > 1) {
     double t = m.allreduce_time(c.psum_bytes, c.psum_k);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
@@ -505,7 +572,8 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     nc.comm += training ? 2.0 * t : t;  // bwd scatters the gradient back
   }
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
-    nc.gradsync = m.allreduce_time(c.gradsync_bytes, c.gradsync_k);
+    nc.gradsync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
+                                        slices_spanned(mesh, m));
   return nc;
 }
 

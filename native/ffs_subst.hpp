@@ -173,6 +173,85 @@ inline std::vector<SubstRule> builtin_rules() {
     r.mapped = {{0, 0, 1, 0}, {1, 0, 1, 1}};
     rules.push_back(std::move(r));
   }
+  {
+    // move Combines past a binary op: Combine(a)+Combine(b) -> EW op
+    // => EW op -> Combine — one all-gather instead of two, and the
+    // elementwise work stays sharded (reference's partition rules around
+    // element-wise chains, substitution.cc:1726)
+    for (const char* b : {"EW_ADD", "EW_MUL"}) {
+      SubstRule r;
+      r.name = std::string("move_combines_past_") + b;
+      r.src = {{"COMBINE", {{-1, 0}}, pm({{"PM_PARALLEL_DIM", wildcard(0)},
+                                          {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+               {"COMBINE", {{-2, 0}}, pm({{"PM_PARALLEL_DIM", wildcard(0)},
+                                          {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+               {b, {{0, 0}, {1, 0}}, {}}};
+      r.dst = {{b, {{-1, 0}, {-2, 0}}, {}},
+               {"COMBINE", {{0, 0}}, pm({{"PM_PARALLEL_DIM", wildcard(0)},
+                                         {"PM_PARALLEL_DEGREE", wildcard(1)}})}};
+      r.mapped = {{2, 0, 1, 0}};
+      rules.push_back(std::move(r));
+    }
+  }
+  {
+    // move a batch-dim Combine past shape-preserving grid ops so the conv
+    // work stays sharded (create_partition_conv2d_combine analog,
+    // substitution.cc:1744): Combine(0,k) -> Conv/Pool/BN
+    // => Conv/Pool/BN -> Combine(0,k)
+    for (const char* g : {"CONV2D", "POOL2D", "BATCHNORM", "LAYERNORM"}) {
+      SubstRule r;
+      r.name = std::string("move_combine_past_") + g;
+      r.src = {{"COMBINE", {{-1, 0}}, pm({{"PM_PARALLEL_DIM", 0.0},
+                                          {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+               {g, {{0, 0}}, {}}};
+      r.dst = {{g, {{-1, 0}}, {}},
+               {"COMBINE", {{0, 0}}, pm({{"PM_PARALLEL_DIM", 0.0},
+                                         {"PM_PARALLEL_DEGREE", wildcard(1)}})}};
+      r.mapped = {{1, 0, 1, 0}};
+      rules.push_back(std::move(r));
+    }
+  }
+  {
+    // push a Repartition above a unary op: RELU -> Repartition(d,k)
+    // => Repartition(d,k) -> RELU — the elementwise work runs sharded
+    // (the reference's create_partition_relu_combine, substitution.cc:1726)
+    for (const char* u : {"RELU", "GELU", "SIGMOID", "TANH"}) {
+      SubstRule r;
+      r.name = std::string("move_repartition_before_") + u;
+      r.src = {{u, {{-1, 0}}, {}},
+               {"REPARTITION", {{0, 0}}, pm({{"PM_PARALLEL_DIM", wildcard(0)},
+                                             {"PM_PARALLEL_DEGREE", wildcard(1)}})}};
+      r.dst = {{"REPARTITION", {{-1, 0}}, pm({{"PM_PARALLEL_DIM", wildcard(0)},
+                                              {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+               {u, {{0, 0}}, {}}};
+      r.mapped = {{1, 0, 1, 0}};
+      rules.push_back(std::move(r));
+    }
+  }
+  {
+    // Concat of two same-degree Combines => Concat -> one Combine, when
+    // the concat axis differs from the combine dim (same-dim case would
+    // interleave shard groups — unsafe). (create_partition_concat_combine
+    // analog, substitution.cc:1793.)
+    for (int d = 0; d < 3; ++d) {
+      for (int a = 0; a < 3; ++a) {
+        if (a == d) continue;
+        SubstRule r;
+        r.name = "concat_of_combines_d" + std::to_string(d) + "_a" +
+                 std::to_string(a);
+        r.src = {{"COMBINE", {{-1, 0}}, pm({{"PM_PARALLEL_DIM", (double)d},
+                                            {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+                 {"COMBINE", {{-2, 0}}, pm({{"PM_PARALLEL_DIM", (double)d},
+                                            {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+                 {"CONCAT", {{0, 0}, {1, 0}}, pm({{"PM_AXIS", (double)a}})}};
+        r.dst = {{"CONCAT", {{-1, 0}, {-2, 0}}, pm({{"PM_AXIS", (double)a}})},
+                 {"COMBINE", {{0, 0}}, pm({{"PM_PARALLEL_DIM", (double)d},
+                                           {"PM_PARALLEL_DEGREE", wildcard(1)}})}};
+        r.mapped = {{2, 0, 1, 0}};
+        rules.push_back(std::move(r));
+      }
+    }
+  }
   return rules;
 }
 
@@ -463,11 +542,24 @@ inline std::optional<Graph> apply_rule(const Graph& g, const SubstRule& rule,
       n.output_shapes = {s};
       n.fwd_flops = (double)shape_elems(in_shapes[0]);
     } else if (t == "IDENTITY" || t == "RELU" || t == "GELU" ||
-               t == "SIGMOID" || t == "TANH") {
+               t == "SIGMOID" || t == "TANH" || t == "ELU" || t == "EXP" ||
+               t == "SIN" || t == "COS" || t == "RSQRT" || t == "DROPOUT" ||
+               t == "CAST" || t.rfind("SCALAR_", 0) == 0) {
       if (in_shapes.size() != 1) return std::nullopt;
       n.output_shapes = {in_shapes[0]};
       n.fwd_flops = (double)shape_elems(in_shapes[0]);
       n.params.clear();
+    } else if (t == "CONV2D" || t == "POOL2D" || t == "BATCHNORM" ||
+               t == "LAYERNORM") {
+      // shape-preserving re-emission: the dst op must inherit from a
+      // matched src op of the same type with identical input shape (rules
+      // only move layout boundaries around these; nothing is resized)
+      if (base == nullptr || in_shapes.empty() ||
+          base->input_shapes.empty() || in_shapes[0] != base->input_shapes[0])
+        return std::nullopt;
+      n.output_shapes = base->output_shapes;
+      n.fwd_flops = base->fwd_flops;
+      n.params = base->params;
     } else if (t == "EW_ADD" || t == "EW_MUL") {
       if (in_shapes.size() != 2) return std::nullopt;
       // broadcast

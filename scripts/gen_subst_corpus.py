@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Generate the shipped substitution-rule corpus.
+
+Analog of the reference's machine-generated TASO rule corpus
+(/root/reference/substitutions/graph_subst_3_v2.json, 640 rules, loaded by
+substitution_loader.cc): systematic expansions of the hand-written rule
+families over the framework's elementwise-op vocabulary and small
+dim/axis ranges. Output is this repo's native list-of-rules JSON, loaded
+at search startup by flexflow_tpu/search/unity.py (and overridable with
+--substitution-json).
+
+Usage: python scripts/gen_subst_corpus.py  # rewrites substitutions/ffs_subst_v1.json
+"""
+
+import json
+import os
+
+WILD = lambda v: -1000.0 - v  # ffs_subst.hpp wildcard encoding
+
+UNARY = ["RELU", "GELU", "SIGMOID", "TANH", "ELU", "EXP", "SIN", "COS",
+         "RSQRT", "IDENTITY", "DROPOUT", "CAST", "SCALAR_MULTIPLY",
+         "SCALAR_ADD", "SCALAR_SUB", "SCALAR_TRUE_DIV"]
+BINARY = ["EW_ADD", "EW_MUL"]
+GRID = ["CONV2D", "POOL2D", "BATCHNORM", "LAYERNORM"]
+
+
+def op(typ, inputs, para=None):
+    return {
+        "type": typ,
+        "input": [{"opId": i, "tsId": t} for i, t in inputs],
+        "para": [{"key": k, "value": v} for k, v in (para or {}).items()],
+    }
+
+
+def pdim(d=None, deg=None):
+    return {"PM_PARALLEL_DIM": WILD(0) if d is None else float(d),
+            "PM_PARALLEL_DEGREE": WILD(1) if deg is None else float(deg)}
+
+
+def rule(name, src, dst, mapped):
+    return {"name": name, "srcOp": src, "dstOp": dst,
+            "mappedOutput": [{"srcOpId": a, "srcTsId": b,
+                              "dstOpId": c, "dstTsId": d}
+                             for a, b, c, d in mapped]}
+
+
+def generate():
+    rules = []
+    # family 1: Combine past every unary (work stays sharded)
+    for u in UNARY:
+        rules.append(rule(
+            f"corpus_move_combine_past_{u}",
+            [op("COMBINE", [(-1, 0)], pdim()), op(u, [(0, 0)])],
+            [op(u, [(-1, 0)]), op("COMBINE", [(0, 0)], pdim())],
+            [(1, 0, 1, 0)]))
+    # family 2: Repartition above every unary (shard earlier)
+    for u in UNARY:
+        rules.append(rule(
+            f"corpus_move_repartition_before_{u}",
+            [op(u, [(-1, 0)]), op("REPARTITION", [(0, 0)], pdim())],
+            [op("REPARTITION", [(-1, 0)], pdim()), op(u, [(0, 0)])],
+            [(1, 0, 1, 0)]))
+    # family 3: Combines past every binary (two gathers -> one)
+    for b in BINARY:
+        rules.append(rule(
+            f"corpus_move_combines_past_{b}",
+            [op("COMBINE", [(-1, 0)], pdim()),
+             op("COMBINE", [(-2, 0)], pdim()),
+             op(b, [(0, 0), (1, 0)])],
+            [op(b, [(-1, 0), (-2, 0)]),
+             op("COMBINE", [(0, 0)], pdim())],
+            [(2, 0, 1, 0)]))
+    # family 4: batch-dim Combine past grid ops (sharded conv/pool/bn)
+    for g in GRID:
+        rules.append(rule(
+            f"corpus_move_combine_past_{g}",
+            [op("COMBINE", [(-1, 0)], pdim(d=0)), op(g, [(0, 0)])],
+            [op(g, [(-1, 0)]), op("COMBINE", [(0, 0)], pdim(d=0))],
+            [(1, 0, 1, 0)]))
+    # family 5: Concat of same-degree Combines -> Concat + one Combine
+    for d in range(4):
+        for a in range(4):
+            if a == d:
+                continue  # same-dim would interleave shard groups
+            rules.append(rule(
+                f"corpus_concat_of_combines_d{d}_a{a}",
+                [op("COMBINE", [(-1, 0)], pdim(d=d)),
+                 op("COMBINE", [(-2, 0)], pdim(d=d)),
+                 op("CONCAT", [(0, 0), (1, 0)], {"PM_AXIS": float(a)})],
+                [op("CONCAT", [(-1, 0), (-2, 0)], {"PM_AXIS": float(a)}),
+                 op("COMBINE", [(0, 0)], pdim(d=d))],
+                [(2, 0, 1, 0)]))
+    # family 6: inverse-pair elimination at fixed dims (the wildcard
+    # builtins cover the general case; fixed-dim variants keep firing when
+    # a corpus replaces the builtins via --substitution-json)
+    for d in range(4):
+        rules.append(rule(
+            f"corpus_eliminate_repartition_combine_d{d}",
+            [op("REPARTITION", [(-1, 0)], pdim(d=d)),
+             op("COMBINE", [(0, 0)], pdim(d=d))],
+            [op("IDENTITY", [(-1, 0)])],
+            [(1, 0, 0, 0)]))
+    return rules
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(repo, "substitutions", "ffs_subst_v1.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    rules = generate()
+    with open(out, "w") as f:
+        json.dump(rules, f, indent=1)
+    print(f"wrote {len(rules)} rules to {out}")
+
+
+if __name__ == "__main__":
+    main()

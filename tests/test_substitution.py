@@ -146,10 +146,12 @@ class TestNativeRewrites:
         assert resp["predicted_time"] < base["predicted_time"] * 0.9
 
     def test_fuses_parallel_linears(self):
-        # two same-input linears + add, data-parallel regime: one wide MXU
-        # matmul + split wins (one gradient all-reduce and one x-read
-        # instead of two, one fewer kernel-dispatch floor)
-        b, d = 2048, 1024
+        # two same-input linears + add in the bandwidth-bound regime
+        # (b >> d): one wide matmul + free split reads x once instead of
+        # twice and saves a kernel dispatch. (Flop-bound shapes model no
+        # win — the MXU does the same FLOPs either way — so the engine
+        # correctly leaves those alone.)
+        b, d = 8192, 256
         nodes = [
             _linear(1, "qa", [-2, 0], b, d, d),
             _linear(2, "qb", [-2, 0], b, d, d),
@@ -170,6 +172,108 @@ class TestNativeRewrites:
         wide = fusion["added"][0]
         assert wide["attrs"]["out_dim"] == 2 * d
         assert [list(map(int, s)) for s in wide["output_shapes"]] == [[b, 2 * d]]
+
+    def test_moves_combines_past_binary(self):
+        # Combine(a) + Combine(b) -> EW_ADD => EW_ADD -> Combine: one
+        # all-gather instead of two, add runs sharded
+        b, d = 64, 1 << 20
+        nodes = [
+            _node(1, "COMBINE", "ca", [[-2, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(2, "COMBINE", "cb", [[-3, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(3, "EW_ADD", "add", [[1, 0], [2, 0]],
+                  [[b, d], [b, d]], [[b, d]], flops=b * d),
+        ]
+        machine = dict(MACHINE, num_devices=2)
+        req = {"machine": machine, "config": _cfg(budget=3),
+               "measured": {}, "nodes": nodes, "final": [3, 0]}
+        resp = native_optimize(req)
+        rules = [r["rule"] for r in resp["rewrites"]]
+        assert "move_combines_past_EW_ADD" in rules, (rules, resp["stats"])
+        base = native_optimize(dict(
+            req, config=_cfg(budget=3, enable_substitution=False)))
+        assert resp["predicted_time"] < base["predicted_time"]
+
+    def test_moves_combine_past_conv(self):
+        # Combine(batch) -> Conv => Conv -> Combine: the gather moves to
+        # the conv's (4x smaller) output and the conv work stays sharded
+        b, ci, co, hw = 8, 64, 16, 32
+        conv_flops = 2.0 * b * co * hw * hw * ci * 9
+        nodes = [
+            _node(1, "COMBINE", "comb", [[-2, 0]],
+                  [[b, ci, hw, hw]], [[b, ci, hw, hw]],
+                  attrs={"dim": 0, "degree": 2}),
+            _node(2, "CONV2D", "conv", [[1, 0]],
+                  [[b, ci, hw, hw]], [[b, co, hw, hw]],
+                  roles=[["sample", "channel", "other", "other"]],
+                  params={"kernel": [co, ci, 3, 3], "bias": [co]},
+                  flops=conv_flops, attrs={"groups": 1}),
+            _node(3, "RELU", "relu", [[2, 0]],
+                  [[b, co, hw, hw]], [[b, co, hw, hw]],
+                  flops=b * co * hw * hw),
+        ]
+        machine = dict(MACHINE, num_devices=2)
+        req = {"machine": machine, "config": _cfg(budget=3, batch=b),
+               "measured": {}, "nodes": nodes, "final": [3, 0]}
+        resp = native_optimize(req)
+        rules = [r["rule"] for r in resp["rewrites"]]
+        assert "move_combine_past_CONV2D" in rules, (rules, resp["stats"])
+        base = native_optimize(dict(
+            req, config=_cfg(budget=3, batch=b, enable_substitution=False)))
+        assert resp["predicted_time"] < base["predicted_time"]
+
+    def test_repartition_push_subsumed_by_choice_dp(self):
+        # Reference rule family: RELU -> Repartition => Repartition -> RELU
+        # (shard the elementwise work earlier). In this framework's design
+        # parallelism is a per-op *sharding choice*, not a graph edit, so
+        # the DP reaches the sharded cost directly: the unary ops pick
+        # 'mp_last' upstream of the boundary and the rewrite is redundant.
+        # The rule ships in the corpus for reference parity; this test pins
+        # the subsumption (no rewrite needed, work already sharded).
+        b, d = 1, 1 << 22
+        nodes = [
+            _node(1, "RELU", "relu", [[-2, 0]], [[b, d]], [[b, d]],
+                  flops=b * d),
+            _node(2, "REPARTITION", "part", [[1, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(3, "GELU", "gelu", [[2, 0]], [[b, d]], [[b, d]],
+                  flops=8.0 * b * d),
+        ]
+        machine = dict(MACHINE, num_devices=2)
+        resp = native_optimize({"machine": machine,
+                                "config": _cfg(budget=3, batch=b),
+                                "measured": {}, "nodes": nodes,
+                                "final": [3, 0]})
+        # the unaries run model-sharded without any graph rewrite
+        assert resp["ops"]["1"]["choice"] == "mp_last"
+        assert resp["ops"]["3"]["choice"] == "mp_last"
+        base = native_optimize({"machine": machine,
+                                "config": _cfg(budget=3, batch=b,
+                                               enable_substitution=False),
+                                "measured": {}, "nodes": nodes,
+                                "final": [3, 0]})
+        assert resp["predicted_time"] <= base["predicted_time"] + 1e-12
+
+    def test_concat_of_combines_merges_gathers(self):
+        b, d = 32, 1 << 18
+        nodes = [
+            _node(1, "COMBINE", "ca", [[-2, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(2, "COMBINE", "cb", [[-3, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(3, "CONCAT", "cat", [[1, 0], [2, 0]],
+                  [[b, d], [b, d]], [[2 * b, d]], attrs={"axis": 0}),
+        ]
+        machine = dict(MACHINE, num_devices=2)
+        req = {"machine": machine, "config": _cfg(budget=3),
+               "measured": {}, "nodes": nodes, "final": [3, 0]}
+        resp = native_optimize(req)
+        rules = [r["rule"] for r in resp["rewrites"]]
+        assert "concat_of_combines_d1_a0" in rules, (rules, resp["stats"])
+        base = native_optimize(dict(
+            req, config=_cfg(budget=3, enable_substitution=False)))
+        assert resp["predicted_time"] < base["predicted_time"]
 
     def test_rewrite_never_drops_designated_output(self):
         # final on the Repartition's output: eliminating the pair would lose
@@ -309,6 +413,22 @@ class TestCompileIntegration:
         ff.compile(SGDOptimizer(lr=0.1),
                    LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
         assert ff.search_info["stats"]["rewrites_applied"] == 0
+
+    def test_default_corpus_loaded_at_startup(self):
+        # the shipped corpus (substitutions/ffs_subst_v1.json — analog of
+        # the reference's graph_subst_3_v2.json) loads when no explicit
+        # --substitution-json is given
+        from flexflow_tpu import (FFConfig, FFModel, LossType, SGDOptimizer)
+
+        cfg = FFConfig(batch_size=32, search_budget=2,
+                       enable_parameter_parallel=True)
+        ff = FFModel(cfg)
+        t = ff.create_tensor((32, 16))
+        ff.dense(t, 8)
+        ff.compile(SGDOptimizer(lr=0.1),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        # 24 builtin generator rules + 54 corpus rules
+        assert ff.search_info["stats"]["rules_loaded"] >= 70
 
     def test_reference_corpus_accepted_by_compile(self, tmp_path):
         # --substitution-json pointing at a reference-format corpus must
