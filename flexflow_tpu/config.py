@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence
 
-from flexflow_tpu.ffconst import CompMode, ParameterSyncType
+from flexflow_tpu.ffconst import CompMode
 
 
 @dataclasses.dataclass
@@ -47,16 +47,23 @@ class FFConfig:
     substitution_json: Optional[str] = None
     memory_search: bool = False
     memory_threshold_mb: Optional[int] = None
+    # real-chip microbenchmark calibration of the search's cost model
+    # (reference: measure_operator_cost, src/runtime/model.cu:38-74)
+    search_measure_ops: bool = False
+    measured_cache_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
     include_costs_dot_graph: bool = False
-    simulator_segment_size: int = 16777216
-    simulator_max_num_segments: int = 1
+    # NOTE deliberately absent vs the reference: simulator_segment_size /
+    # simulator_max_num_segments (the reference chunks its simulator's
+    # device-memory pool; this simulator is native C++ with no pool) and
+    # parameter_sync (GSPMD has exactly one sync mechanism — XLA
+    # collectives). Accepting-and-ignoring a knob is worse than rejecting
+    # it, so the flags now fall through to the application's argv.
 
     # execution
     computation_mode: CompMode = CompMode.TRAINING
-    parameter_sync: ParameterSyncType = ParameterSyncType.NCCL
     perform_fusion: bool = True
     profiling: bool = False
     allow_mixed_precision: bool = True  # bf16 matmuls, f32 accumulate/params
@@ -131,6 +138,10 @@ class FFConfig:
                 self.substitution_json = take()
             elif a == "--disable-substitution":
                 self.enable_substitution = False
+            elif a == "--search-measure-ops":
+                self.search_measure_ops = True
+            elif a == "--measured-cache":
+                self.measured_cache_file = take()
             elif a == "--memory-search":
                 self.memory_search = True
             elif a == "--memory-threshold":
@@ -147,10 +158,6 @@ class FFConfig:
                 self.machine_model_version = int(take())
             elif a == "--machine-model-file":
                 self.machine_model_file = take()
-            elif a == "--simulator-segment-size":
-                self.simulator_segment_size = int(take())
-            elif a == "--simulator-max-num-segments":
-                self.simulator_max_num_segments = int(take())
             elif a == "--overlap":
                 self.search_overlap_backward_update = True
             elif a == "--disable-fusion":

@@ -165,6 +165,7 @@ class FFModel:
     def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
                             embed_dim: int, num_heads: int, kdim: int = 0,
                             vdim: int = 0, dropout: float = 0.0, bias: bool = True,
+                            qkv_bias: bool = False,
                             add_bias_kv: bool = False, add_zero_attn: bool = False,
                             causal: bool = False, kernel_initializer=None,
                             seq_parallel: Optional[str] = None,
@@ -174,7 +175,8 @@ class FFModel:
         layer = self._add_layer(OperatorType.MULTIHEAD_ATTENTION,
                                 [query, key, value], dict(
             embed_dim=embed_dim, num_heads=num_heads, kdim=kdim or embed_dim,
-            vdim=vdim or embed_dim, dropout=dropout, bias=bias, causal=causal,
+            vdim=vdim or embed_dim, dropout=dropout, bias=bias,
+            qkv_bias=qkv_bias, causal=causal,
             kernel_initializer=kernel_initializer, seq_parallel=seq_parallel), name)
         return self._finish(layer)
 
@@ -458,6 +460,14 @@ class FFModel:
         # num_devices == 0 means "auto: use every visible device"
         n_dev = min(cfg.num_devices, avail) if cfg.num_devices > 0 else avail
         batch0 = self.input_tensors[0].shape[0] if self.input_tensors else 1
+        if machine_spec is None and cfg.machine_model_file:
+            # --machine-model-file / --machine-model-version (reference
+            # model.cc:3640): version >= 1 selects the file-based model
+            from flexflow_tpu.machine import MachineSpec
+            machine_spec = MachineSpec.from_file(cfg.machine_model_file)
+        elif cfg.machine_model_version > 0 and not cfg.machine_model_file:
+            raise ValueError(
+                "--machine-model-version > 0 requires --machine-model-file")
         self.machine_spec = machine_spec or detect_machine_spec(n_dev)
         self.search_info = None
 
@@ -514,9 +524,24 @@ class FFModel:
         elif (cfg.search_budget > 0 and not cfg.only_data_parallel
               and mesh is None):
             try:
+                # optimizer-state copies for the simulator's memory/update
+                # model: 0 plain SGD, 1 momentum, 2 Adam-family
+                from flexflow_tpu.optimizers import SGDOptimizer as _SGD
+                if isinstance(self.optimizer, _SGD):
+                    cfg.opt_state_factor = (
+                        1.0 if self.optimizer.momentum else 0.0)
+                else:
+                    cfg.opt_state_factor = 2.0
+                measured = None
+                if cfg.search_measure_ops:
+                    # calibrate the cost model with real-device op timings
+                    # (analog of the reference's measure_operator_cost pass)
+                    from flexflow_tpu.search.profile import microbenchmark
+                    measured = microbenchmark(
+                        nodes, cache_file=cfg.measured_cache_file)
                 mesh_axes, self.strategy, self.search_info = _unity.graph_optimize(
                     nodes, self.machine_spec, cfg, n_dev, batch=batch0,
-                    final_ref=final_ref)
+                    measured=measured, final_ref=final_ref)
                 self.mesh = make_mesh(_math.prod(mesh_axes.values()), mesh_axes)
                 # the substitution engine may have rewritten the graph —
                 # run the rewritten node list (strategy is keyed to it)
@@ -544,6 +569,25 @@ class FFModel:
             _unity.export_strategy_file(cfg.export_strategy_file, axes_now,
                                         self.strategy, nodes)
         apply_strategy(nodes, self.strategy, self.mesh)
+        self.op_profile = None
+        if cfg.profiling:
+            # --profiling (reference model.cc profiling mode wraps every
+            # task with timers): microbenchmark each op on the device and
+            # report the per-op fwd/bwd table through the RecursiveLogger
+            from flexflow_tpu.search.profile import microbenchmark
+            from flexflow_tpu.utils.logger import RecursiveLogger
+            plog = RecursiveLogger("profiling")
+            with plog.enter(f"per-op device microbenchmarks "
+                            f"({len(nodes)} ops)"):
+                prof = microbenchmark(nodes,
+                                      cache_file=cfg.measured_cache_file)
+                for node in nodes:
+                    f_s = prof.get(f"{node.guid}:fwd")
+                    b_s = prof.get(f"{node.guid}:bwd")
+                    if f_s is not None:
+                        plog.info(f"{node.op.name}: fwd {f_s * 1e6:9.1f}us  "
+                                  f"bwd {b_s * 1e6:9.1f}us")
+            self.op_profile = prof
         if cfg.export_strategy_computation_graph_file:
             from flexflow_tpu.utils.dot import export_strategy_dot
             export_strategy_dot(nodes, self.mesh,

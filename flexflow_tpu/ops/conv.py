@@ -55,6 +55,9 @@ class Conv2D(Op):
     def forward(self, params, inputs, ctx: OpContext):
         (x,) = inputs
         w = params["kernel"].astype(ctx.compute_dtype)
+        # no preferred_element_type: conv_general_dilated's transpose rule
+        # rejects mixed (bf16 operand, f32 cotangent) convs under autodiff;
+        # the TPU MXU accumulates bf16 convs in f32 internally regardless
         y = lax.conv_general_dilated(
             x.astype(ctx.compute_dtype),
             w,
@@ -62,8 +65,7 @@ class Conv2D(Op):
             padding=[(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32,
-        )
+        ).astype(jnp.float32)
         if self.use_bias:
             y = y + params["bias"][None, :, None, None]
         return [apply_activation(y, self.activation).astype(x.dtype)]
