@@ -66,6 +66,11 @@ class MultiHeadAttention(Op):
         self.dropout = p.get("dropout", 0.0)
         self.causal = p.get("causal", False)
         self.use_bias = p.get("bias", True)
+        # separate q/k/v projection biases (torch nn.MultiheadAttention
+        # parity — in_proj_bias). Off by default: they cost an extra
+        # elementwise pass over q/k/v every step and native models
+        # initialize them to zero anyway.
+        self.qkv_bias = p.get("qkv_bias", False)
         # sequence/context parallelism: run the attention core as ring
         # attention over this mesh axis (SURVEY §5.7 — new vs reference)
         self.seq_parallel = p.get("seq_parallel", None)
@@ -90,6 +95,12 @@ class MultiHeadAttention(Op):
         }
         if self.use_bias:
             params["bo"] = jnp.zeros((e,))
+            if self.qkv_bias:
+                # [H, D]: head axis first so attribute parallelism shards
+                # them with the weights (torch in_proj_bias parity)
+                params["bq"] = jnp.zeros((h, d))
+                params["bk"] = jnp.zeros((h, d))
+                params["bv"] = jnp.zeros((h, d))
         return params
 
     def forward(self, params, inputs, ctx: OpContext):
@@ -101,6 +112,10 @@ class MultiHeadAttention(Op):
                        preferred_element_type=jnp.float32)
         v = jnp.einsum("bse,hed->bhsd", value.astype(cd), params["wv"].astype(cd),
                        preferred_element_type=jnp.float32)
+        if self.qkv_bias and "bq" in params:
+            q = q + params["bq"][None, :, None, :]
+            k = k + params["bk"][None, :, None, :]
+            v = v + params["bv"][None, :, None, :]
         rng = ctx.next_rng() if (self.dropout > 0 and ctx.training) else None
         dropout_rate = self.dropout if ctx.training else 0.0
         seq_axis = self.seq_parallel
@@ -126,10 +141,27 @@ class MultiHeadAttention(Op):
                                causal=self.causal)
         elif (dropout_rate == 0.0 and q.shape[2] == k.shape[2]):
             from flexflow_tpu.ops.pallas_kernels import (
-                flash_attention, flash_attention_available)
+                flash_attention, flash_attention_available,
+                flash_attention_sharded)
 
             if flash_attention_available(q.shape[2], q.shape[3]):
-                o = flash_attention(q, k, v, causal=self.causal)
+                if any(s > 1 for s in mesh_axes.values()):
+                    # non-trivial mesh: the raw pallas_call would be an
+                    # unpartitionable custom call under GSPMD — run it
+                    # per-shard via shard_map over the batch ('data') and,
+                    # when the search picked a head choice, the head axis
+                    batch_axis = ("data" if mesh_axes.get("data", 1) > 1
+                                  and q.shape[0] % mesh_axes["data"] == 0
+                                  else None)
+                    hp = self.head_parallel
+                    head_axis = (hp if hp and mesh_axes.get(hp, 1) > 1
+                                 and q.shape[1] % mesh_axes[hp] == 0
+                                 else None)
+                    o = flash_attention_sharded(
+                        q, k, v, ctx.mesh, batch_axis=batch_axis,
+                        head_axis=head_axis, causal=self.causal)
+                else:
+                    o = flash_attention(q, k, v, causal=self.causal)
             else:
                 o = scaled_dot_product_attention(
                     q, k, v, causal=self.causal, dropout_rate=0.0,
@@ -158,4 +190,7 @@ class MultiHeadAttention(Op):
 
     def params_elems(self):
         h, e, d = self.num_heads, self.embed_dim, self.head_dim
-        return h * d * (e + self.kdim + self.vdim + e) + (e if self.use_bias else 0)
+        n = h * d * (e + self.kdim + self.vdim + e)
+        if self.use_bias:
+            n += e + (3 * h * d if self.qkv_bias else 0)
+        return n
