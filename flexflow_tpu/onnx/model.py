@@ -1,24 +1,31 @@
 """ONNX graph → FFModel translation.
 
-Analog of python/flexflow/onnx/model.py (375 LoC in the reference): walks
-``model.graph.node`` in order and emits the corresponding FFModel layer per
-ONNX op_type. The ``onnx`` package is optional in this environment (no
-pip installs): ``ONNXModel(path)`` requires it, but ``ONNXModel(model)``
-accepts any object with the ModelProto structure (``graph.node[*].op_type/
-input/output/attribute``), which is also how the unit tests drive the
-translation table devicelessly.
+Analog of the reference's python/flexflow/onnx/model.py: walks
+``model.graph.node`` in order and emits the corresponding FFModel layer
+per ONNX op_type, deriving Gemm/MatMul/Conv/BatchNorm configurations from
+the graph's **initializer payloads** — exactly as the reference reads
+tensor data to size its layers — so standard exported models load with no
+custom attributes. The trained weights themselves transfer into the
+compiled model via :meth:`ONNXModel.copy_weights_to`.
+
+Accepted inputs: a ``.onnx`` path or raw ModelProto bytes (parsed by the
+dependency-free reader in :mod:`flexflow_tpu.onnx.proto`), an ``onnx``
+package ModelProto (when that package is importable), or any duck-typed
+object with the ModelProto structure.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from flexflow_tpu.ffconst import ActiMode, PoolType
 from flexflow_tpu.model import FFModel
 
 
 # AttributeProto.AttributeType values (onnx.proto): which field is live
-_ATTR_TYPE_FIELD = {1: "f", 2: "i", 3: "s", 6: "floats", 7: "ints"}
+_ATTR_TYPE_FIELD = {1: "f", 2: "i", 3: "s", 4: "t", 6: "floats", 7: "ints"}
 
 
 def _attrs(node) -> Dict[str, Any]:
@@ -32,7 +39,7 @@ def _attrs(node) -> Dict[str, Any]:
         else:
             # duck-typed stand-in (tests / no onnx package): first field
             # actually set wins
-            fields = ("i", "f", "s", "ints", "floats")
+            fields = ("i", "f", "s", "t", "ints", "floats")
         for field in fields:
             v = getattr(a, field, None)
             if v is None:
@@ -41,23 +48,61 @@ def _attrs(node) -> Dict[str, Any]:
                 v = v.decode()
             if field in ("ints", "floats"):
                 v = list(v)
+            if field == "t":
+                v = _tensor_to_numpy(v)
             out[a.name] = v
             break
     return out
 
 
+def _tensor_to_numpy(t) -> np.ndarray:
+    """TensorProto (own reader, onnx package, or duck-typed) → ndarray."""
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "to_numpy"):
+        return t.to_numpy()
+    try:
+        from onnx import numpy_helper  # pragma: no cover
+
+        return numpy_helper.to_array(t)
+    except ImportError:
+        pass
+    from flexflow_tpu.onnx.proto import TENSOR_DTYPES
+
+    dtype = TENSOR_DTYPES.get(getattr(t, "data_type", 1), np.float32)
+    shape = tuple(getattr(t, "dims", ()))
+    raw = getattr(t, "raw_data", b"")
+    if raw:
+        return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+    for field in ("float_data", "int64_data", "int32_data", "double_data"):
+        data = list(getattr(t, field, []) or [])
+        if data:
+            return np.asarray(data, dtype=dtype).reshape(shape)
+    return np.zeros(shape, dtype=dtype)
+
+
 class ONNXModel:
     def __init__(self, model):
-        if isinstance(model, str):
-            try:
-                import onnx
-            except ImportError as e:  # pragma: no cover
-                raise ImportError(
-                    "the 'onnx' package is required to load .onnx files; "
-                    "pass a ModelProto-like object instead") from e
-            model = onnx.load(model)
-        self.model = model
+        if isinstance(model, (str, bytes)):
+            if isinstance(model, str):
+                with open(model, "rb") as f:
+                    model = f.read()
+            from flexflow_tpu.onnx.proto import parse_model
 
+            model = parse_model(model)
+        self.model = model
+        # initializer payloads: name -> ndarray (the reference reads these
+        # to size Gemm/Conv and we additionally keep them for weight import)
+        self.initializers: Dict[str, np.ndarray] = {}
+        for t in getattr(model.graph, "initializer", []):
+            self.initializers[t.name] = _tensor_to_numpy(t)
+        # layer name -> {ff param name: ndarray} staged for copy_weights_to
+        self._imports: Dict[str, Dict[str, np.ndarray]] = {}
+        # layer name -> {state name: ndarray} (BatchNorm running stats live
+        # in the model's non-trainable state collection, not params)
+        self._state_imports: Dict[str, Dict[str, np.ndarray]] = {}
+
+    # ---- graph walk --------------------------------------------------------
     def apply(self, ff: FFModel, input_tensors: Dict[str, Any]):
         """Translate the graph; returns the tensor of the last node output.
 
@@ -69,37 +114,104 @@ class ONNXModel:
             out = self._emit(ff, node, env)
         return out
 
+    def copy_weights_to(self, ff: FFModel) -> int:
+        """After ``ff.compile``: load the ONNX initializer weights into the
+        model's parameters. Returns the number of arrays copied."""
+        import jax
+        import jax.numpy as jnp
+
+        copied = 0
+        for layer_name, params in self._imports.items():
+            for pname, arr in params.items():
+                try:
+                    ff.set_parameter(layer_name, arr, pname)
+                    copied += 1
+                except (KeyError, ValueError):
+                    pass  # layer absent after rewrites / shape mismatch
+        for layer_name, stats in self._state_imports.items():
+            st = ff.state.get(layer_name)
+            if st is None:
+                continue
+            for sname, arr in stats.items():
+                old = st.get(sname)
+                if old is None or tuple(old.shape) != tuple(arr.shape):
+                    continue
+                st[sname] = jax.device_put(jnp.asarray(arr, old.dtype),
+                                           old.sharding)
+                copied += 1
+        return copied
+
+    def _weights(self, node) -> List[Optional[np.ndarray]]:
+        """Initializer payload per node input (None for activations)."""
+        return [self.initializers.get(i) for i in node.input]
+
     def _emit(self, ff: FFModel, node, env: Dict[str, Any]):
         op = node.op_type
         at = _attrs(node)
-        # data inputs only (weights come from initializers and are created
-        # by the FFModel layer itself)
+        # data inputs only (weights come from initializers)
         ins = [env[i] for i in node.input if i in env]
+        wts = self._weights(node)
         name = node.output[0]
 
         def done(t):
             env[name] = t
             return t
 
+        if op == "Constant":
+            value = at.get("value")
+            if value is None:
+                raise ValueError(f"Constant {name}: no tensor attribute")
+            self.initializers[name] = np.asarray(value)
+            return None
         if op == "Gemm" or op == "MatMul":
-            # out_dim from the weight initializer is not available without
-            # the tensor data; FFModel needs it via attribute or env hint
+            w = next((w for w in wts[1:] if w is not None and w.ndim == 2),
+                     None)
+            if w is not None:
+                trans_b = bool(at.get("transB", 0)) if op == "Gemm" else False
+                kernel = w.T if trans_b else w  # ff dense kernel: [in, out]
+                out_dim = kernel.shape[1]
+                bias = next((b for b in wts[1:]
+                             if b is not None and b.ndim == 1), None)
+                t = ff.dense(ins[0], int(out_dim),
+                             use_bias=bias is not None, name=name)
+                imp = {"kernel": np.ascontiguousarray(kernel,
+                                                      dtype=np.float32)}
+                if bias is not None:
+                    imp["bias"] = np.asarray(bias, dtype=np.float32)
+                self._imports[name] = imp
+                return done(t)
+            # no initializer (dynamic weight or legacy stand-in): fall back
+            # to the explicit attribute the pre-initializer frontend used
             out_dim = at.get("out_dim") or at.get("N")
             if out_dim is None:
                 raise ValueError(
-                    f"{op} node {name}: provide 'out_dim' attribute (the "
-                    f"frontend does not read initializer payloads)")
+                    f"{op} node {name}: weight initializer not found and no "
+                    f"'out_dim' attribute given")
             return done(ff.dense(ins[0], int(out_dim),
                                  use_bias=(op == "Gemm"), name=name))
         if op == "Conv":
-            k = at.get("kernel_shape", [1, 1])
+            w = wts[1] if len(wts) > 1 else None
+            if w is not None and w.ndim == 4:
+                out_ch = w.shape[0]  # OIHW, matches ff conv2d kernel layout
+                k = at.get("kernel_shape", list(w.shape[2:]))
+                imp = {"kernel": np.asarray(w, dtype=np.float32)}
+                bias = wts[2] if len(wts) > 2 else None
+                if bias is not None:
+                    imp["bias"] = np.asarray(bias, dtype=np.float32)
+                self._imports[name] = imp
+            else:
+                k = at.get("kernel_shape", [1, 1])
+                out_ch = at.get("out_channels")
+                bias = None
+                if out_ch is None:
+                    raise ValueError(
+                        f"Conv node {name}: weight initializer not found "
+                        f"and no 'out_channels' attribute given")
             s = at.get("strides", [1, 1])
             p = at.get("pads", [0, 0, 0, 0])
-            out_ch = at.get("out_channels")
-            if out_ch is None:
-                raise ValueError(f"Conv node {name}: provide 'out_channels'")
             return done(ff.conv2d(ins[0], int(out_ch), k[0], k[1], s[0], s[1],
                                   p[0], p[1], groups=int(at.get("group", 1)),
+                                  use_bias=(w is None or len(wts) > 2),
                                   name=name))
         if op in ("MaxPool", "AveragePool"):
             k = at.get("kernel_shape", [2, 2])
@@ -111,9 +223,29 @@ class ONNXModel:
         if op == "GlobalAveragePool":
             return done(ff.mean(ins[0], [2, 3], keepdims=True, name=name))
         if op == "BatchNormalization":
-            return done(ff.batch_norm(ins[0], relu=False, name=name))
+            # inputs: X, scale, B, input_mean, input_var (onnx.proto)
+            t = ff.batch_norm(ins[0], relu=False, name=name)
+            if len(wts) >= 3 and wts[1] is not None and wts[2] is not None:
+                self._imports[name] = {
+                    "scale": np.asarray(wts[1], dtype=np.float32),
+                    "bias": np.asarray(wts[2], dtype=np.float32),
+                }
+            if len(wts) >= 5 and wts[3] is not None and wts[4] is not None:
+                # trained running stats: inference must use them, not the
+                # init-state defaults (mean=0, var=1)
+                self._state_imports[name] = {
+                    "mean": np.asarray(wts[3], dtype=np.float32),
+                    "var": np.asarray(wts[4], dtype=np.float32),
+                }
+            return done(t)
         if op == "LayerNormalization":
-            return done(ff.layer_norm(ins[0], name=name))
+            t = ff.layer_norm(ins[0], name=name)
+            if len(wts) >= 2 and wts[1] is not None:
+                imp = {"scale": np.asarray(wts[1], dtype=np.float32)}
+                if len(wts) >= 3 and wts[2] is not None:
+                    imp["bias"] = np.asarray(wts[2], dtype=np.float32)
+                self._imports[name] = imp
+            return done(t)
         if op == "Relu":
             return done(ff.relu(ins[0], name=name))
         if op == "Gelu":
@@ -148,6 +280,10 @@ class ONNXModel:
             return done(ff.concat(ins, int(at.get("axis", 0)), name=name))
         if op == "Split":
             sizes = at.get("split")
+            if sizes is None and len(node.input) > 1:
+                arr = self.initializers.get(node.input[1])
+                if arr is not None:  # opset >= 13: sizes as constant input
+                    sizes = [int(x) for x in arr]
             outs = ff.split(ins[0], sizes if sizes else len(node.output),
                             int(at.get("axis", 0)), name=name)
             for out_name, t in zip(node.output, outs):
@@ -157,9 +293,13 @@ class ONNXModel:
             return done(ff.flat(ins[0], name=name))
         if op == "Reshape":
             shape = at.get("shape")
+            if shape is None and len(node.input) > 1:
+                arr = self.initializers.get(node.input[1])
+                if arr is not None:  # standard export: shape as constant
+                    shape = [int(x) for x in arr]
             if shape is None:
-                raise ValueError(f"Reshape {name}: constant-input reshape "
-                                 f"needs 'shape' attribute")
+                raise ValueError(f"Reshape {name}: shape neither attribute "
+                                 f"nor constant initializer")
             batch = ins[0].shape[0]
             shape = [batch if s in (0, -1) and i == 0 else int(s)
                      for i, s in enumerate(shape)]
