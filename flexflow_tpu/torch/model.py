@@ -30,6 +30,23 @@ def _torch():
     return torch
 
 
+def _encoder_layer_cfg(layer) -> Dict[str, Any]:
+    """Config of one nn.TransformerEncoderLayer (leaf-traced composite)."""
+    act = getattr(layer, "activation", None)
+    act_name = getattr(act, "__name__", type(act).__name__ if act else "relu")
+    return dict(
+        d_model=layer.self_attn.embed_dim,
+        nhead=layer.self_attn.num_heads,
+        dim_feedforward=layer.linear1.out_features,
+        activation="gelu" if "gelu" in act_name.lower() else "relu",
+        norm_first=bool(getattr(layer, "norm_first", False)),
+        eps=layer.norm1.eps,
+        dropout=float(getattr(layer.dropout, "p", 0.0)),
+        attn_dropout=float(layer.self_attn.dropout),
+        batch_first=getattr(layer.self_attn, "batch_first", False),
+    )
+
+
 # ---- graph description (the .ff-file schema) ------------------------------
 
 def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
@@ -86,7 +103,15 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
             cfg = dict(p=mod.p)
         elif isinstance(mod, nn.MultiheadAttention):
             cfg = dict(embed_dim=mod.embed_dim, num_heads=mod.num_heads,
+                       dropout=mod.dropout,
+                       kdim=mod.kdim, vdim=mod.vdim,
+                       bias=mod.in_proj_bias is not None,
                        batch_first=getattr(mod, "batch_first", False))
+        elif isinstance(mod, nn.TransformerEncoderLayer):
+            cfg = _encoder_layer_cfg(mod)
+        elif isinstance(mod, nn.TransformerEncoder):
+            cfg = dict(num_layers=mod.num_layers,
+                       layer=_encoder_layer_cfg(mod.layers[0]))
         elif isinstance(mod, nn.Softmax):
             cfg = dict(dim=mod.dim)
         elif isinstance(mod, nn.Flatten):
@@ -225,8 +250,30 @@ class PyTorchModel:
                 return ff.flat(args[0], name=name)
             if target == "MultiheadAttention":
                 q, k, v = (args + [args[0], args[0]])[:3]
-                return ff.multihead_attention(
-                    q, k, v, cfg["embed_dim"], cfg["num_heads"], name=name)
+                if not cfg.get("batch_first", False):
+                    # torch default layout is [S, B, E]; ours is [B, S, E]
+                    q = ff.transpose(q, [1, 0, 2], name=f"{name}_qt")
+                    k = (q if k is args[0] else
+                         ff.transpose(k, [1, 0, 2], name=f"{name}_kt"))
+                    v = (q if v is args[0] else
+                         ff.transpose(v, [1, 0, 2], name=f"{name}_vt"))
+                out = ff.multihead_attention(
+                    q, k, v, cfg["embed_dim"], cfg["num_heads"],
+                    kdim=cfg.get("kdim") or 0, vdim=cfg.get("vdim") or 0,
+                    dropout=cfg.get("dropout", 0.0),
+                    bias=cfg.get("bias", True),
+                    qkv_bias=cfg.get("bias", True), name=name)
+                if not cfg.get("batch_first", False):
+                    out = ff.transpose(out, [1, 0, 2], name=f"{name}_ot")
+                return out
+            if target == "TransformerEncoderLayer":
+                return self._emit_encoder_layer(ff, name, cfg, args[0])
+            if target == "TransformerEncoder":
+                t = args[0]
+                for i in range(cfg["num_layers"]):
+                    t = self._emit_encoder_layer(ff, f"{name}_l{i}",
+                                                 cfg["layer"], t)
+                return t
             if target == "ReLU":
                 return ff.relu(args[0], name=name)
             if target == "GELU":
@@ -240,6 +287,46 @@ class PyTorchModel:
         elif op in ("call_function", "call_method"):
             return self._emit_function(ff, target, args, kwargs, name)
         raise NotImplementedError(f"fx node {op}:{target} has no translation")
+
+    def _emit_encoder_layer(self, ff: FFModel, name: str, cfg: Dict, t):
+        """Composite expansion of one nn.TransformerEncoderLayer (fx leaves
+        torch.nn modules untraced, so the frontend re-expresses the block:
+        post-norm `x = ln(x + sub(x))` or pre-norm `x = x + sub(ln(x))`)."""
+        if not cfg.get("batch_first", False):
+            t = ff.transpose(t, [1, 0, 2], name=f"{name}_in_t")
+        act = ff.gelu if cfg.get("activation") == "gelu" else ff.relu
+        norm_first = cfg.get("norm_first", False)
+        eps = cfg.get("eps", 1e-5)
+        drop = cfg.get("dropout", 0.0)
+
+        def dropped(x, tag):
+            return ff.dropout(x, drop, name=f"{name}_{tag}") if drop else x
+
+        def sa(x):
+            a = ff.multihead_attention(
+                x, x, x, cfg["d_model"], cfg["nhead"], qkv_bias=True,
+                dropout=cfg.get("attn_dropout", 0.0), name=f"{name}_attn")
+            return dropped(a, "drop1")  # torch's dropout1 after attention
+
+        def ffn(x):
+            h = ff.dense(x, cfg["dim_feedforward"], name=f"{name}_ff1")
+            h = dropped(act(h, name=f"{name}_act"), "dropa")
+            return dropped(ff.dense(h, cfg["d_model"], name=f"{name}_ff2"),
+                           "drop2")
+
+        if norm_first:
+            t = ff.add(t, sa(ff.layer_norm(t, eps=eps, name=f"{name}_ln1")),
+                       name=f"{name}_res1")
+            t = ff.add(t, ffn(ff.layer_norm(t, eps=eps, name=f"{name}_ln2")),
+                       name=f"{name}_res2")
+        else:
+            t = ff.layer_norm(ff.add(t, sa(t), name=f"{name}_res1"),
+                              eps=eps, name=f"{name}_ln1")
+            t = ff.layer_norm(ff.add(t, ffn(t), name=f"{name}_res2"),
+                              eps=eps, name=f"{name}_ln2")
+        if not cfg.get("batch_first", False):
+            t = ff.transpose(t, [1, 0, 2], name=f"{name}_out_t")
+        return t
 
     def _emit_function(self, ff: FFModel, target: str, args, kwargs, name):
         binop = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
@@ -287,10 +374,12 @@ class PyTorchModel:
         if target == "flatten":
             return ff.flat(args[0], name=name)
         if target in ("reshape", "view"):
-            shape = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
-            batch = args[0].shape[0]
-            shape = [batch if s == -1 and i == 0 else s
-                     for i, s in enumerate(shape)]
+            shape = list(args[1] if isinstance(args[1], (list, tuple))
+                         else args[1:])
+            if -1 in shape:  # infer the free dim from the input's elements
+                total = int(np.prod(args[0].shape))
+                known = int(np.prod([s for s in shape if s != -1]))
+                shape[shape.index(-1)] = total // max(known, 1)
             return ff.reshape(args[0], shape, name=name)
         if target in ("transpose", "permute"):
             x = args[0]
@@ -332,8 +421,75 @@ class PyTorchModel:
             if idx == 0:
                 return obj
             return None
-        if target == "contiguous":
+        if target in ("contiguous", "clone", "detach", "float", "to",
+                      "type_as", "alias"):
             return args[0]
+        if target == "getattr":
+            # e.g. `x.shape` on a traced tensor: static shapes are known
+            return getattr(args[0], args[1])
+        if target == "exp":
+            return ff.exp(args[0], name=name)
+        if target == "sin":
+            return ff.sin(args[0], name=name)
+        if target == "cos":
+            return ff.cos(args[0], name=name)
+        if target == "pow":
+            return ff.pow(args[0], float(args[1]), name=name)
+        if target == "sqrt":
+            return ff.pow(args[0], 0.5, name=name)
+        if target == "rsqrt":
+            return ff.rsqrt(args[0], name=name)
+        if target == "neg":
+            return ff.scalar_multiply(args[0], -1.0, name=name)
+        if target in ("unsqueeze", "squeeze"):
+            x = args[0]
+            shape = list(x.shape)
+            dim = args[1] if len(args) > 1 else None
+            if target == "unsqueeze":
+                dim = dim if dim >= 0 else dim + len(shape) + 1
+                shape.insert(dim, 1)
+            elif dim is None:
+                shape = [s for s in shape if s != 1] or [1]
+            else:
+                dim = dim if dim >= 0 else dim + len(shape)
+                if shape[dim] == 1:
+                    shape.pop(dim)
+            return ff.reshape(x, shape, name=name)
+        if target in ("chunk", "split"):
+            x = args[0]
+            axis = kwargs.get("dim", args[2] if len(args) > 2 else 0)
+            arg = args[1]
+            if target == "chunk":
+                sizes = int(arg)  # n equal chunks
+            else:  # split(size_or_sections, dim)
+                sizes = (list(arg) if isinstance(arg, (list, tuple))
+                         else max(1, x.shape[axis] // int(arg)))
+            return tuple(ff.split(x, sizes, axis, name=name))
+        if target == "stack":
+            ts = args[0]
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            rank = len(ts[0].shape)
+            axis = axis if axis >= 0 else axis + rank + 1  # new-axis space
+            ts2 = [ff.reshape(t, list(t.shape[:axis]) + [1]
+                              + list(t.shape[axis:]), name=f"{name}_u{i}")
+                   for i, t in enumerate(ts)]
+            return ff.concat(ts2, axis, name=name)
+        if target == "layer_norm":
+            ns = kwargs.get("normalized_shape",
+                            args[1] if len(args) > 1 else None)
+            nd = len(ns) if ns else 1
+            return ff.layer_norm(args[0], axes=tuple(range(-nd, 0)),
+                                 eps=kwargs.get("eps", 1e-5), name=name)
+        if target == "leaky_relu":
+            # max(x, alpha*x)
+            alpha = kwargs.get("negative_slope",
+                               args[1] if len(args) > 1 else 0.01)
+            scaled = ff.scalar_multiply(args[0], float(alpha),
+                                        name=f"{name}_scaled")
+            return ff.max(args[0], scaled, name=name)
+        if target == "silu":
+            sig = ff.sigmoid(args[0], name=f"{name}_sig")
+            return ff.multiply(args[0], sig, name=name)
         if target == "size":
             raise NotImplementedError(
                 "dynamic .size() in traced graph — use static shapes")
@@ -342,32 +498,87 @@ class PyTorchModel:
     # ---- weight transfer --------------------------------------------------
     def copy_weights_to(self, ff: FFModel) -> int:
         """Copy torch parameters into the compiled FFModel (transposing
-        Linear kernels torch [out,in] → ours [in,out]). Returns #tensors."""
+        Linear kernels torch [out,in] → ours [in,out]). Returns #modules."""
         torch = _torch()
-        nn = torch.nn
         copied = 0
         mods = dict(self.module.named_modules())
         traced = torch.fx.symbolic_trace(self.module)
         for node in traced.graph.nodes:
-            if node.op != "call_module":
-                continue
-            mod = mods[node.target]
-            name = node.name
-            try:
-                if isinstance(mod, nn.Linear):
-                    ff.set_parameter(name,
-                                     mod.weight.detach().numpy().T, "kernel")
-                    if mod.bias is not None:
-                        ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+            if node.op == "call_module":
+                copied += self._copy_module(ff, node.name, mods[node.target])
+        return copied
+
+    def _copy_module(self, ff: FFModel, name: str, mod) -> int:
+        torch = _torch()
+        nn = torch.nn
+        copied = 0
+        try:
+            if isinstance(mod, nn.Linear):
+                ff.set_parameter(name, mod.weight.detach().numpy().T, "kernel")
+                if mod.bias is not None:
+                    ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+                copied += 1
+            elif isinstance(mod, nn.Conv2d):
+                ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
+                if mod.bias is not None:
+                    ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+                copied += 1
+            elif isinstance(mod, nn.Embedding):
+                ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
+                copied += 1
+            elif isinstance(mod, (nn.LayerNorm, nn.BatchNorm2d)):
+                if getattr(mod, "weight", None) is not None:
+                    ff.set_parameter(name, mod.weight.detach().numpy(),
+                                     "scale")
+                    ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
                     copied += 1
-                elif isinstance(mod, nn.Conv2d):
-                    ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
-                    if mod.bias is not None:
-                        ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
-                    copied += 1
-                elif isinstance(mod, nn.Embedding):
-                    ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
-                    copied += 1
-            except KeyError:
-                pass  # layer had no parameters in the compiled graph
+            elif isinstance(mod, nn.MultiheadAttention):
+                copied += self._copy_mha(ff, name, mod)
+            elif isinstance(mod, nn.TransformerEncoderLayer):
+                copied += self._copy_encoder_layer(ff, name, mod)
+            elif isinstance(mod, nn.TransformerEncoder):
+                for i, layer in enumerate(mod.layers):
+                    copied += self._copy_encoder_layer(ff, f"{name}_l{i}",
+                                                       layer)
+        except (KeyError, AttributeError):
+            pass  # layer absent in the compiled graph / unexpected module
+        return copied
+
+    def _copy_mha(self, ff: FFModel, name: str, mod) -> int:
+        """torch packed in_proj [3E, E] → our per-head wq/wk/wv [H, E, D]
+        (+ bq/bk/bv [H, D]), out_proj [E, HD] → wo [H, D, E]. With
+        kdim/vdim != embed_dim torch stores separate q/k/v_proj_weight
+        instead of the packed matrix."""
+        e, h = mod.embed_dim, mod.num_heads
+        d = e // h
+        if mod.in_proj_weight is not None:
+            w = mod.in_proj_weight.detach().numpy()  # [3E,E], head-major
+            blocks = [w[i * e:(i + 1) * e] for i in range(3)]
+        else:
+            blocks = [mod.q_proj_weight.detach().numpy(),
+                      mod.k_proj_weight.detach().numpy(),
+                      mod.v_proj_weight.detach().numpy()]
+        for blk, pname in zip(blocks, ("wq", "wk", "wv")):
+            in_dim = blk.shape[1]  # [E_out, in_dim]; in_dim = e/kdim/vdim
+            ff.set_parameter(name,
+                             blk.reshape(h, d, in_dim).transpose(0, 2, 1),
+                             pname)
+        if mod.in_proj_bias is not None:
+            b = mod.in_proj_bias.detach().numpy()
+            for i, pname in enumerate(("bq", "bk", "bv")):
+                ff.set_parameter(name, b[i * e:(i + 1) * e].reshape(h, d),
+                                 pname)
+        wo = mod.out_proj.weight.detach().numpy()  # [E_out, HD]
+        ff.set_parameter(name, wo.transpose(1, 0).reshape(h, d, e), "wo")
+        if mod.out_proj.bias is not None:
+            ff.set_parameter(name, mod.out_proj.bias.detach().numpy(), "bo")
+        return 1
+
+    def _copy_encoder_layer(self, ff: FFModel, name: str, layer) -> int:
+        """Mirror _emit_encoder_layer's naming scheme."""
+        copied = self._copy_mha(ff, f"{name}_attn", layer.self_attn)
+        copied += self._copy_module(ff, f"{name}_ff1", layer.linear1)
+        copied += self._copy_module(ff, f"{name}_ff2", layer.linear2)
+        copied += self._copy_module(ff, f"{name}_ln1", layer.norm1)
+        copied += self._copy_module(ff, f"{name}_ln2", layer.norm2)
         return copied
