@@ -59,9 +59,11 @@ def main():
     rng = jrandom.PRNGKey(0)
     # warmup (compile; a second round catches the donation-aliased
     # recompile); float() forces a real device->host sync — on the
-    # tunneled TPU backend block_until_ready alone does not. Measured:
-    # async per-step dispatch pipelines as well as a fused lax.scan loop
-    # (make_multi_step), so the plain loop is the honest protocol.
+    # tunneled TPU backend block_until_ready alone does not. Measured
+    # (r3, 30 iters, v5e): plain loop 160.35 samples/s vs
+    # make_multi_step lax.scan 156.46 — async per-step dispatch pipelines
+    # better than the fused scan (scan serializes the donation chain), so
+    # the plain loop is both the honest protocol and the faster one.
     for _ in range(3):
         params, opt_state, state, rng, loss = step(params, opt_state, state, rng)
     float(loss)
@@ -75,28 +77,102 @@ def main():
     assert np.isfinite(final_loss), f"training diverged: loss={final_loss}"
     samples_per_s = cfg.batch_size * iters / dt
 
+    # ---- ratchet: best-ever per workload key --------------------------
+    # The key is protocol name + platform ONLY — never the config dict.
+    # (Round 2 masked a regression because a new config field invalidated
+    # the recorded baseline; a schema change must not reset the ratchet.)
+    workload = f"bert_proxy:{'cpu' if on_cpu else 'tpu'}"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_history.json")
-    baseline = None
+    hist = {}
     if os.path.exists(hist_path):
         try:
-            baseline = json.load(open(hist_path)).get("samples_per_s")
+            hist = json.load(open(hist_path))
         except Exception:
-            baseline = None
+            hist = {}
+    if "samples_per_s" in hist:
+        # migrate the r1/r2 flat format; those rounds were recorded on the
+        # TPU by the driver, so the number belongs to the tpu key
+        # regardless of where THIS run executes
+        hist = {"bert_proxy:tpu": {"samples_per_s": hist["samples_per_s"]}}
+    baseline = (hist.get(workload) or {}).get("samples_per_s")
     vs_baseline = samples_per_s / baseline if baseline else 1.0
     try:
-        # record the best-known number so vs_baseline is vs best, not last
-        json.dump({"samples_per_s": max(samples_per_s, baseline or 0.0),
-                   "config": dataclass_dict(cfg)}, open(hist_path, "w"))
+        hist[workload] = {
+            "samples_per_s": max(samples_per_s, baseline or 0.0),
+            "config": dataclass_dict(cfg),
+        }
+        json.dump(hist, open(hist_path, "w"))
     except Exception:
         pass
 
-    print(json.dumps({
+    result = {
         "metric": "bert_proxy_train_throughput",
         "value": round(samples_per_s, 3),
         "unit": "samples/s",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }
+    ratio = searched_vs_dp_ratio(on_cpu)
+    if ratio is not None:
+        # BASELINE.md north star: predicted searched/DP throughput on a
+        # simulated v4-32 (the OSDI'22 AE protocol's headline comparison)
+        result.update(ratio)
+    print(json.dumps(result))
+
+
+def searched_vs_dp_ratio(on_cpu):
+    """Unity-search vs --only-data-parallel predicted iteration time for
+    the BERT-proxy on a simulated TPU v4-32.
+
+    Protocol mirrors the reference's OSDI'22 AE comparison
+    (scripts/osdi22ae/bert.sh: global batch 8 on 4 GPUs — *strong*
+    scaling, ~1-2 samples per device, plain SGD): global batch = n_chips,
+    where DP's per-parameter gradient sync cannot amortize and a hybrid
+    strategy wins. At large per-chip batch DP is genuinely near-optimal
+    on TPU (sync hides under backward) and the honest ratio approaches 1.
+    """
+    try:
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.machine import MachineSpec
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.optimizers import SGDOptimizer
+        from flexflow_tpu.search.native import available, native_optimize
+        from flexflow_tpu.search.unity import machine_to_json, serialize_graph
+
+        if not available():
+            return None
+        n_chips = 32
+        mcfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                                  seq_length=64, batch_size=n_chips)
+                if on_cpu else
+                TransformerConfig(batch_size=n_chips))
+        ff = create_transformer(
+            mcfg, FFConfig(batch_size=mcfg.batch_size,
+                           only_data_parallel=True, workers_per_node=1))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        nodes = serialize_graph(ff.executor.nodes)
+        machine = machine_to_json(
+            MachineSpec(chip="tpu-v4", chips_per_slice=n_chips), n_chips)
+        base_cfg = dict(budget=8, alpha=0.05, training=True, overlap=True,
+                        batch=mcfg.batch_size, opt_state_factor=0.0,
+                        seed=42, rules=[])
+        searched = native_optimize(dict(
+            nodes=nodes, machine=machine, measured={},
+            config=dict(base_cfg, enable_parameter_parallel=True)))
+        dp = native_optimize(dict(
+            nodes=nodes, machine=machine, measured={},
+            config=dict(base_cfg, only_data_parallel=True)))
+        r = dp["predicted_time"] / searched["predicted_time"]
+        mesh = {k: v for k, v in searched["mesh"].items() if v > 1}
+        return {
+            "searched_vs_dp_v4_32": round(r, 3),
+            "searched_mesh_v4_32": mesh or {"data": 1},
+        }
+    except Exception:
+        return None
 
 
 def dataclass_dict(cfg):

@@ -85,6 +85,8 @@ class MachineSpec:
     dcn_bw: float = 25e9  # bytes/s per slice pair
     ici_latency: float = 1e-6
     dcn_latency: float = 10e-6
+    mxu_efficiency: float = 0.55  # achieved fraction of peak on real shapes
+    min_op_time: float = 5e-7     # per-kernel dispatch overhead (seconds)
 
     def __post_init__(self):
         if self.torus is None:
@@ -99,6 +101,72 @@ class MachineSpec:
         self.hbm_bw = spec["hbm_bw"]
         self.hbm_cap = spec["hbm_cap"]
         self.ici_bw = spec["ici_bw"]
+
+    # keys a --machine-model-file may set, with unit conversions from the
+    # reference's GB/s + ms conventions where they map
+    _FILE_KEYS = {
+        "chip": ("chip", str),
+        "chips_per_slice": ("chips_per_slice", int),
+        "num_slices": ("num_slices", int),
+        "flops": ("flops", float),
+        "hbm_bw": ("hbm_bw", float),
+        "hbm_cap": ("hbm_cap", float),
+        "ici_bw": ("ici_bw", float),
+        "ici_latency": ("ici_latency", float),
+        "dcn_bw": ("dcn_bw", float),
+        "dcn_latency": ("dcn_latency", float),
+        "mxu_efficiency": ("mxu_efficiency", float),
+        "min_op_time": ("min_op_time", float),
+        # reference machine_config_example vocabulary (GB/s, ms):
+        # nodes = DCN domains; nvlink = intra-node device link -> ICI;
+        # nic = inter-node link -> DCN
+        "num_nodes": ("num_slices", int),
+        "nvlink_bandwidth": ("ici_bw", lambda v: float(v) * 1e9),
+        "nvlink_latency": ("ici_latency", lambda v: float(v) * 1e-3),
+        "nic_bandwidth": ("dcn_bw", lambda v: float(v) * 1e9),
+        "nic_latency": ("dcn_latency", lambda v: float(v) * 1e-3),
+    }
+
+    @classmethod
+    def from_file(cls, path: str) -> "MachineSpec":
+        """Parse a --machine-model-file: JSON with this class's field
+        names, or the reference's ``key = value`` format
+        (machine_config_example) with its GPU-era keys mapped onto the
+        TPU model (nvlink→ICI, nic→DCN, num_nodes→slices). Unknown keys
+        are ignored, as the reference's parser does."""
+        import json as _json
+
+        with open(path) as f:
+            text = f.read()
+        values: Dict[str, object] = {}
+        try:
+            data = _json.loads(text)
+            if isinstance(data, dict):
+                values = data
+        except ValueError:
+            for line in text.splitlines():
+                line = line.split("#", 1)[0].strip()
+                if "=" not in line:
+                    continue
+                k, v = (s.strip() for s in line.split("=", 1))
+                values[k] = v
+        init = {}
+        overrides = {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        for key, raw in values.items():
+            mapped = cls._FILE_KEYS.get(key)
+            if mapped is None:
+                continue
+            name, conv = mapped
+            val = conv(raw)
+            if name in field_names:
+                init[name] = val
+            else:
+                overrides[name] = val  # flops/hbm_bw/...: post-init attrs
+        spec = cls(**init)
+        for name, val in overrides.items():
+            setattr(spec, name, val)
+        return spec
 
     @property
     def num_devices(self) -> int:
