@@ -102,6 +102,9 @@ class OperatorType(enum.Enum):
     # attention / transformer
     MULTIHEAD_ATTENTION = enum.auto()
     LAYERNORM = enum.auto()
+    # RMSNorm: new scope vs the reference (no analog in ffconst.h) — the
+    # Llama/T5 model family's normalization
+    RMSNORM = enum.auto()
     SOFTMAX = enum.auto()
     # elementwise
     EW_ADD = enum.auto()

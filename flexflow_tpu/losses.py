@@ -24,8 +24,14 @@ def categorical_crossentropy(logits, labels):
 
 
 def sparse_categorical_crossentropy(logits, labels):
-    labels = labels.reshape(labels.shape[0], -1)[..., 0] if labels.ndim > 1 else labels
+    """[B, C] logits with [B]/[B,1] labels (classification), or [B, S, V]
+    logits with [B, S]/[B,S,1] labels (token-level LM objective)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if logits.ndim == 3:
+        lab = labels.reshape(labels.shape[0], labels.shape[1], -1)[..., :1]
+        tok = jnp.take_along_axis(logp, lab.astype(jnp.int32), axis=-1)
+        return -jnp.mean(tok)
+    labels = labels.reshape(labels.shape[0], -1)[..., 0] if labels.ndim > 1 else labels
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1))
 
 

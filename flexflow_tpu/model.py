@@ -154,6 +154,14 @@ class FFModel:
             axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps), name)
         return self._finish(layer)
 
+    def rms_norm(self, input: Tensor, eps: float = 1e-6,
+                 name: Optional[str] = None) -> Tensor:
+        """RMSNorm over the last dim (Llama/T5 family; new scope vs the
+        reference)."""
+        layer = self._add_layer(OperatorType.RMSNORM, [input],
+                                dict(eps=eps), name)
+        return self._finish(layer)
+
     def embedding(self, input: Tensor, num_entries: int, out_dim: int,
                   aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
                   kernel_initializer=None, name: Optional[str] = None) -> Tensor:
@@ -167,7 +175,9 @@ class FFModel:
                             vdim: int = 0, dropout: float = 0.0, bias: bool = True,
                             qkv_bias: bool = False,
                             add_bias_kv: bool = False, add_zero_attn: bool = False,
-                            causal: bool = False, kernel_initializer=None,
+                            causal: bool = False, num_kv_heads: int = 0,
+                            rope: bool = False, rope_theta: float = 10000.0,
+                            kernel_initializer=None,
                             seq_parallel: Optional[str] = None,
                             name: Optional[str] = None) -> Tensor:
         """``seq_parallel='seq'`` runs the attention core as ring attention
@@ -177,6 +187,8 @@ class FFModel:
             embed_dim=embed_dim, num_heads=num_heads, kdim=kdim or embed_dim,
             vdim=vdim or embed_dim, dropout=dropout, bias=bias,
             qkv_bias=qkv_bias, causal=causal,
+            num_kv_heads=num_kv_heads or num_heads, rope=rope,
+            rope_theta=rope_theta,
             kernel_initializer=kernel_initializer, seq_parallel=seq_parallel), name)
         return self._finish(layer)
 

@@ -15,6 +15,8 @@ from flexflow_tpu.models.inception import create_inception_v3, InceptionConfig
 from flexflow_tpu.models.candle_uno import create_candle_uno, CandleUnoConfig
 from flexflow_tpu.models.xdl import create_xdl, XDLConfig
 from flexflow_tpu.models.moe_model import create_moe, create_moe_encoder, MoEConfig
+from flexflow_tpu.models.llama import (create_llama, import_hf_weights,
+                                       LlamaModelConfig)
 
 __all__ = [
     "create_transformer",
@@ -36,4 +38,5 @@ __all__ = [
     "create_moe",
     "create_moe_encoder",
     "MoEConfig",
+    "create_llama", "import_hf_weights", "LlamaModelConfig",
 ]

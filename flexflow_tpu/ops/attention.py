@@ -20,6 +20,20 @@ from flexflow_tpu.initializers import DefaultWeightInitializer
 from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
 
 
+def rotary_embedding(x, *, theta: float = 10000.0):
+    """Apply RoPE to [B, H, S, D] (HF Llama rotate-half convention):
+    positions 0..S-1, inv_freq = theta^(-2i/D)."""
+    b, h, s, d = x.shape
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)  # [S, D]
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin
+            ).astype(x.dtype)
+
+
 def scaled_dot_product_attention(q, k, v, *, causal=False, dropout_rate=0.0,
                                  rng=None, compute_dtype=jnp.float32):
     """q,k,v: [B, H, S, D] -> [B, H, S, D]. Softmax in f32 for stability."""
@@ -66,6 +80,16 @@ class MultiHeadAttention(Op):
         self.dropout = p.get("dropout", 0.0)
         self.causal = p.get("causal", False)
         self.use_bias = p.get("bias", True)
+        # grouped-query attention (Llama-family): kv heads may be fewer
+        # than query heads; kv repeat to H before the core
+        self.num_kv_heads = p.get("num_kv_heads") or self.num_heads
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"attention '{layer.name}': num_heads ({self.num_heads}) "
+                f"must be a multiple of num_kv_heads ({self.num_kv_heads})")
+        # rotary position embeddings applied to q/k after projection
+        self.rope = p.get("rope", False)
+        self.rope_theta = p.get("rope_theta", 10000.0)
         # separate q/k/v projection biases (torch nn.MultiheadAttention
         # parity — in_proj_bias). Off by default: they cost an extra
         # elementwise pass over q/k/v every step and native models
@@ -86,21 +110,23 @@ class MultiHeadAttention(Op):
 
     def init_params(self, rng):
         h, e, d = self.num_heads, self.embed_dim, self.head_dim
+        hk = self.num_kv_heads
         ks = jax.random.split(rng, 4)
         params = {
             "wq": self.kernel_init(ks[0], (h, e, d)),
-            "wk": self.kernel_init(ks[1], (h, self.kdim, d)),
-            "wv": self.kernel_init(ks[2], (h, self.vdim, d)),
+            "wk": self.kernel_init(ks[1], (hk, self.kdim, d)),
+            "wv": self.kernel_init(ks[2], (hk, self.vdim, d)),
             "wo": self.kernel_init(ks[3], (h, d, e)),
         }
         if self.use_bias:
             params["bo"] = jnp.zeros((e,))
             if self.qkv_bias:
-                # [H, D]: head axis first so attribute parallelism shards
-                # them with the weights (torch in_proj_bias parity)
+                # head axis first so attribute parallelism shards them
+                # with the weights (torch in_proj_bias parity); bk/bv
+                # carry the kv-head count under GQA
                 params["bq"] = jnp.zeros((h, d))
-                params["bk"] = jnp.zeros((h, d))
-                params["bv"] = jnp.zeros((h, d))
+                params["bk"] = jnp.zeros((hk, d))
+                params["bv"] = jnp.zeros((hk, d))
         return params
 
     def forward(self, params, inputs, ctx: OpContext):
@@ -116,6 +142,13 @@ class MultiHeadAttention(Op):
             q = q + params["bq"][None, :, None, :]
             k = k + params["bk"][None, :, None, :]
             v = v + params["bv"][None, :, None, :]
+        if self.rope:
+            q = rotary_embedding(q, theta=self.rope_theta)
+            k = rotary_embedding(k, theta=self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         rng = ctx.next_rng() if (self.dropout > 0 and ctx.training) else None
         dropout_rate = self.dropout if ctx.training else 0.0
         seq_axis = self.seq_parallel
@@ -184,13 +217,16 @@ class MultiHeadAttention(Op):
         b, sq, e = self.input_shapes[0]
         sk = self.input_shapes[1][1] if len(self.input_shapes) > 1 else sq
         h, d = self.num_heads, self.head_dim
-        proj = 2 * b * h * d * (sq * e + sk * self.kdim + sk * self.vdim + sq * e)
+        hk = self.num_kv_heads  # GQA: k/v projections use the kv heads
+        proj = (2 * b * h * d * (sq * e + sq * e)
+                + 2 * b * hk * d * (sk * self.kdim + sk * self.vdim))
         core = 2 * b * h * sq * sk * d * 2
         return proj + core
 
     def params_elems(self):
         h, e, d = self.num_heads, self.embed_dim, self.head_dim
-        n = h * d * (e + self.kdim + self.vdim + e)
+        hk = self.num_kv_heads
+        n = h * d * (e + e) + hk * d * (self.kdim + self.vdim)
         if self.use_bias:
-            n += e + (3 * h * d if self.qkv_bias else 0)
+            n += e + ((h + 2 * hk) * d if self.qkv_bias else 0)
         return n

@@ -62,6 +62,39 @@ class LayerNorm(Op):
         return 2 * int(np.prod(self._norm_shape())) if self.elementwise_affine else 0
 
 
+@register_op(OperatorType.RMSNORM)
+class RMSNorm(Op):
+    """Root-mean-square normalization over the last dim (Llama/T5 family;
+    new scope vs the reference). y = x / rms(x) * scale, computed in f32."""
+
+    def __init__(self, layer, input_shapes):
+        self.eps = layer.get_property("eps", 1e-6)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def init_params(self, rng):
+        return {"scale": jnp.ones((self.input_shapes[0][-1],))}
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        xf = x.astype(jnp.float32)
+        rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                            + self.eps)
+        return [(xf * rms * params["scale"]).astype(x.dtype)]
+
+    def output_dim_roles(self):
+        shp = self.output_shapes[0]
+        roles = [DimRole.SAMPLE] + [DimRole.OTHER] * (len(shp) - 1)
+        if len(shp) == 3:
+            roles[1] = DimRole.SEQ  # per-position norm: seq-shardable
+        return [tuple(roles)]
+
+    def params_elems(self):
+        return int(self.input_shapes[0][-1])
+
+
 @register_op(OperatorType.SOFTMAX)
 class Softmax(Op):
     def __init__(self, layer, input_shapes):

@@ -80,10 +80,15 @@ def tensor_parallel_overrides(nodes, mesh, strategy: Strategy) -> Strategy:
         elif op.op_type == OperatorType.MULTIHEAD_ATTENTION and op.num_heads % mp == 0:
             st.param_specs.update({
                 "wq": P("model", None, None),
-                "wk": P("model", None, None),
-                "wv": P("model", None, None),
                 "wo": P("model", None, None),
             })
+            # GQA: wk/wv carry num_kv_heads (< num_heads) on dim 0 — only
+            # shard them when the kv-head count divides the axis too
+            if getattr(op, "num_kv_heads", op.num_heads) % mp == 0:
+                st.param_specs.update({
+                    "wk": P("model", None, None),
+                    "wv": P("model", None, None),
+                })
         elif op.op_type == OperatorType.EMBEDDING and op.out_dim % mp == 0:
             st.param_specs["kernel"] = P(None, "model")
     return strategy
@@ -100,7 +105,7 @@ _FOLLOW_OPS = frozenset({
     OperatorType.IDENTITY, OperatorType.SCALAR_MULTIPLY,
     OperatorType.SCALAR_ADD, OperatorType.SCALAR_SUB,
     OperatorType.SCALAR_TRUE_DIV, OperatorType.DROPOUT, OperatorType.CAST,
-    OperatorType.SOFTMAX, OperatorType.LAYERNORM,
+    OperatorType.SOFTMAX, OperatorType.LAYERNORM, OperatorType.RMSNORM,
 })
 
 

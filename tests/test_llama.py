@@ -1,0 +1,140 @@
+"""Llama model family (BASELINE.md stretch): RMSNorm + RoPE + GQA + SwiGLU,
+numerics-checked against HuggingFace LlamaForCausalLM."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.ffconst import MetricsType
+from flexflow_tpu.models.llama import (LlamaModelConfig, create_llama,
+                                       import_hf_weights)
+
+
+def _compiled(cfg, **ffkw):
+    ff = create_llama(cfg, FFConfig(batch_size=cfg.batch_size, **ffkw))
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return ff
+
+
+class TestLlama:
+    def test_logits_match_hf(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rms_norm_eps=1e-6, rope_theta=10000.0,
+            attention_bias=False, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+        cfg = LlamaModelConfig(batch_size=2, seq_length=16)
+        ff = _compiled(cfg, only_data_parallel=True, workers_per_node=1)
+        assert import_hf_weights(ff, hf) == 3 + 9 * 2  # embed+final_ln+head + 9/layer
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 256, (2, 16)).astype(np.int32)
+        want = hf(torch.from_numpy(ids.astype(np.int64))).logits.detach().numpy()
+        got = ff.predict(ids)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_trains_token_level_ce(self):
+        cfg = LlamaModelConfig(batch_size=4, seq_length=16)
+        ff = create_llama(cfg, FFConfig(batch_size=4))
+        ff.compile(SGDOptimizer(lr=0.5),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        rs = np.random.RandomState(1)
+        # learnable pattern: next token = (token + 1) % vocab
+        ids = rs.randint(0, 255, (32, 16)).astype(np.int32)
+        labels = ((ids + 1) % 256).astype(np.int32)
+        l0 = ff.evaluate(ids, labels)["loss"]
+        ff.fit(ids, labels, epochs=10, verbose=False)
+        l1 = ff.evaluate(ids, labels)["loss"]
+        assert l1 < l0 * 0.9, (l0, l1)
+
+    def test_searched_parallel_llama_runs(self):
+        # the search sees a normal PCG: head axis (4 heads), seq axis, batch
+        cfg = LlamaModelConfig(batch_size=16, seq_length=16)
+        ff = create_llama(cfg, FFConfig(batch_size=16, search_budget=2,
+                                        enable_parameter_parallel=True))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        rs = np.random.RandomState(2)
+        ids = rs.randint(0, 256, (16, 16)).astype(np.int32)
+        labels = ((ids + 1) % 256).astype(np.int32)
+        ff.fit(ids, labels, epochs=1, verbose=False)
+        out = ff.predict(ids)
+        assert out.shape == (16, 16, 256)
+        assert np.isfinite(out).all()
+
+    def test_ring_attention_llama_matches_dense(self):
+        # seq parallel via ring attention on the virtual mesh vs the same
+        # weights on a single device
+        from flexflow_tpu.machine import make_mesh
+
+        cfg = LlamaModelConfig(batch_size=4, seq_length=32,
+                               seq_parallel="seq")
+        mesh = make_mesh(8, {"data": 2, "seq": 4})
+        ff = create_llama(cfg, FFConfig(batch_size=4))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                   mesh=mesh)
+        cfg1 = LlamaModelConfig(batch_size=4, seq_length=32)
+        ff1 = _compiled(cfg1, only_data_parallel=True, workers_per_node=1)
+        # copy ff's params into ff1
+        for name in ff.get_layer_names():
+            for pname in list(ff.params.get(name, {})):
+                ff1.set_parameter(name, ff.get_parameter(name, pname), pname)
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, 256, (4, 32)).astype(np.int32)
+        np.testing.assert_allclose(ff.predict(ids), ff1.predict(ids),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_gqa_with_parameter_parallel_mesh(self):
+        # review regression: wk/wv have num_kv_heads on dim 0 — sharding
+        # them on a model axis that divides num_heads but not num_kv_heads
+        # must not be attempted (4 heads, 2 kv heads, model axis 4)
+        from flexflow_tpu.machine import make_mesh
+
+        cfg = LlamaModelConfig(batch_size=8, seq_length=16,
+                               num_attention_heads=4, num_key_value_heads=2)
+        mesh = make_mesh(8, {"data": 2, "model": 4})
+        ff = create_llama(cfg, FFConfig(batch_size=8,
+                                        enable_parameter_parallel=True))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [], mesh=mesh)
+        rs = np.random.RandomState(4)
+        ids = rs.randint(0, 256, (8, 16)).astype(np.int32)
+        out = ff.predict(ids)
+        assert np.isfinite(out).all()
+
+    def test_gqa_qkv_bias_broadcasts(self):
+        # review regression: bk/bv must carry num_kv_heads, not num_heads
+        import jax
+        from flexflow_tpu.ffconst import DataType, OperatorType
+        from flexflow_tpu.layer import Layer
+        from flexflow_tpu.ops import OpRegistry
+        from flexflow_tpu.ops.base import OpContext
+
+        lyr = Layer(OperatorType.MULTIHEAD_ATTENTION, "attn", [],
+                    data_type=DataType.FLOAT)
+        lyr.properties.update(embed_dim=32, num_heads=4, num_kv_heads=2,
+                              qkv_bias=True, dropout=0.0)
+        op = OpRegistry.create(lyr, [(2, 8, 32)] * 3)
+        params = op.init_params(jax.random.PRNGKey(0))
+        assert params["bk"].shape == (2, 8) and params["bq"].shape == (4, 8)
+        x = np.random.RandomState(5).randn(2, 8, 32).astype(np.float32)
+        (out,) = op.forward(params, [x, x, x], OpContext(training=False))
+        assert out.shape == (2, 8, 32)
+
+    def test_bad_kv_head_count_fails_fast(self):
+        from flexflow_tpu.ffconst import DataType, OperatorType
+        from flexflow_tpu.layer import Layer
+        from flexflow_tpu.ops import OpRegistry
+
+        lyr = Layer(OperatorType.MULTIHEAD_ATTENTION, "attn", [],
+                    data_type=DataType.FLOAT)
+        lyr.properties.update(embed_dim=48, num_heads=6, num_kv_heads=4)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            OpRegistry.create(lyr, [(2, 8, 48)] * 3)
