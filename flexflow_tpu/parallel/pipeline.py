@@ -1,0 +1,125 @@
+"""Pipeline parallelism: SPMD GPipe over a 'pipe' mesh axis.
+
+New executing scope vs the reference, where pipeline parallelism exists
+only as an enum value (`/root/reference/include/flexflow/ffconst.h:153`
+OP_PIPELINE, with no runtime behind it).
+
+TPU-native design (the MaxText/praxis recipe): a model whose body is S
+identical repeated stages stacks each stage's parameters on a leading
+[S, ...] axis sharded over the 'pipe' mesh axis. Under ``shard_map``
+every device holds one stage's weights; microbatch activations flow
+stage-to-stage with ``jax.lax.ppermute`` over the pipe ring. The GPipe
+schedule runs T = M + S - 1 ticks for M microbatches (bubble fraction
+(S-1)/T); each device computes on the microbatch that has reached its
+stage and forwards the result one hop. Backward is ordinary JAX autodiff
+through the shard_map — the transpose of ppermute is the reverse-ring
+ppermute, so the returning gradient pipeline falls out of jax.grad.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
+                  axis: str = "pipe", data_axis: str = "data"):
+    """Run ``stage_fn`` as an S-stage GPipe pipeline.
+
+    stage_fn(params_slice, x) -> y: one stage's computation; input and
+        output must share shape/dtype (repeated-block models).
+    stacked_params: pytree with leading dim S == mesh axis size, sharded
+        over ``axis``.
+    x: [B, ...] global batch; B % num_microbatches == 0, and the
+        microbatch size is the unit each stage processes per tick. When
+        ``data_axis`` names a mesh axis, each microbatch additionally
+        shards over it (pipeline x data composition).
+    Returns y of x's shape: the last stage's outputs, gathered.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = sizes[axis]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked param dim 0 is {leaf.shape[0]} but the '{axis}' "
+                f"mesh axis has {S} stages — a mismatch would silently "
+                f"drop stages")
+    M = num_microbatches
+    if x.shape[0] % M:
+        raise ValueError(f"batch {x.shape[0]} % microbatches {M} != 0")
+    data_axis = data_axis if sizes.get(data_axis, 1) > 1 else None
+    if data_axis and (x.shape[0] // M) % sizes[data_axis]:
+        raise ValueError(
+            f"microbatch size {x.shape[0] // M} % '{data_axis}' axis "
+            f"({sizes[data_axis]}) != 0")
+
+    def body(params, xs):
+        # params: [1, ...] this device's stage; xs: [M, B/M, ...] (replicated)
+        idx = jax.lax.axis_index(axis)
+        p = jax.tree.map(lambda w: w[0], params)
+        mb = xs.shape[1]
+        state = jnp.zeros((mb,) + xs.shape[2:], xs.dtype)  # in-flight act
+        outs = jnp.zeros_like(xs)
+
+        def tick(t, carry):
+            state, outs = carry
+            # stage 0 ingests microbatch t (while it exists); others take
+            # the activation ppermuted from the previous stage
+            feed = jnp.where(t < M, t, M - 1)
+            inject = jax.lax.dynamic_index_in_dim(xs, feed, 0,
+                                                  keepdims=False)
+            cur = jnp.where(idx == 0, inject, state)
+            y = stage_fn(p, cur)
+            # the microbatch leaving the last stage this tick is t-(S-1)
+            done = t - (S - 1)
+            valid = jnp.logical_and(idx == S - 1,
+                                    jnp.logical_and(done >= 0, done < M))
+            slot = jnp.clip(done, 0, M - 1)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(valid, y,
+                          jax.lax.dynamic_index_in_dim(outs, slot, 0,
+                                                       keepdims=False)),
+                slot, 0)
+            # forward the activation one hop around the pipe ring
+            state = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % S) for i in range(S)])
+            return state, outs
+
+        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (state, outs))
+        # every device returns outs; only the last stage's is real — psum
+        # after zeroing the others yields the replicated result
+        outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis)
+
+    pipe_spec = P(axis)
+    # microbatch dim replicated; the batch-within-microbatch dim shards
+    # over the data axis so pipeline x data composes (each data shard
+    # pipelines its slice of every microbatch)
+    x_spec = P(None, data_axis) if data_axis else P()
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: pipe_spec, stacked_params), x_spec),
+        out_specs=x_spec, check_rep=False)
+    mb = x.shape[0] // M
+    xs = x.reshape((M, mb) + x.shape[1:])
+    return fn(stacked_params, xs).reshape(x.shape)
+
+
+def stack_stage_params(per_stage_params):
+    """[params_stage0, ..., params_stageS-1] (identical trees) -> one tree
+    with a leading [S, ...] axis, ready to shard over 'pipe'."""
+    return jax.tree.map(lambda *ws: jnp.stack(ws), *per_stage_params)
+
+
+def shard_stacked(stacked_params, mesh, axis: str = "pipe"):
+    """Place the stacked tree with dim 0 sharded over the pipe axis."""
+    def put(w):
+        spec = P(axis, *([None] * (w.ndim - 1)))
+        return jax.device_put(w, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, stacked_params)
