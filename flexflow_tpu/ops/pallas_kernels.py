@@ -222,7 +222,7 @@ def flash_attention_sharded(q, k, v, mesh, batch_axis=None, head_axis=None,
     each device runs the kernel on its local [B/dp, H/mp, S, D] block
     (scores never cross shards; no collectives needed). Axes not named
     stay replicated, which GSPMD enforces on entry."""
-    from jax.experimental.shard_map import shard_map
+    from flexflow_tpu.utils.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(batch_axis, head_axis, None, None)

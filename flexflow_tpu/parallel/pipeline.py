@@ -22,8 +22,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.utils.shard_map_compat import shard_map
 
 
 def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
@@ -39,6 +40,13 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
         ``data_axis`` names a mesh axis, each microbatch additionally
         shards over it (pipeline x data composition).
     Returns y of x's shape: the last stage's outputs, gathered.
+
+    Memory note: the microbatch queue (and the output buffer) replicate
+    over the pipe axis — each stage device holds the full (data-sharded)
+    batch although it only computes on one in-flight microbatch. For
+    memory-bound deployments the queue should stream from stage 0 only;
+    that variant trades this implementation's simple SPMD schedule for a
+    sharded-queue one and is left as the optimization path.
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = sizes[axis]
@@ -108,6 +116,63 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
     mb = x.shape[0] // M
     xs = x.reshape((M, mb) + x.shape[1:])
     return fn(stacked_params, xs).reshape(x.shape)
+
+
+def transformer_block_stage(embed_dim: int, num_heads: int, seq_length: int,
+                            batch_per_microbatch: int, ffn_mult: int = 4):
+    """(init_fn, stage_fn) for one pre-norm transformer block built from
+    the framework's own op implementations — the repeated stage a
+    pipelined transformer runs on each 'pipe' shard.
+
+    init_fn(rng) -> params pytree for one stage;
+    stage_fn(params, x[Bmb, S, E]) -> same shape.
+
+    ``seq_length``/``batch_per_microbatch`` are construction-time shape
+    metadata only (Op instances are built against concrete shapes); the
+    returned stage_fn itself is shape-polymorphic, so running it on a
+    differently-sized (e.g. data-sharded) block is fine.
+    """
+    from flexflow_tpu.ffconst import ActiMode, DataType, OperatorType
+    from flexflow_tpu.layer import Layer
+    from flexflow_tpu.ops import OpRegistry
+    from flexflow_tpu.ops.base import OpContext
+
+    b, s, e = batch_per_microbatch, seq_length, embed_dim
+
+    def make(op_type, props, shapes):
+        lyr = Layer(op_type, None, [], data_type=DataType.FLOAT)
+        lyr.properties.update(props)
+        return OpRegistry.create(lyr, shapes)
+
+    ln1 = make(OperatorType.LAYERNORM, dict(axes=(-1,)), [(b, s, e)])
+    attn = make(OperatorType.MULTIHEAD_ATTENTION,
+                dict(embed_dim=e, num_heads=num_heads, dropout=0.0),
+                [(b, s, e)] * 3)
+    ln2 = make(OperatorType.LAYERNORM, dict(axes=(-1,)), [(b, s, e)])
+    ff1 = make(OperatorType.LINEAR,
+               dict(out_dim=e * ffn_mult,
+                    activation=ActiMode.AC_MODE_RELU), [(b, s, e)])
+    ff2 = make(OperatorType.LINEAR, dict(out_dim=e), [(b, s, e * ffn_mult)])
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 5)
+        return {"ln1": ln1.init_params(ks[0]),
+                "attn": attn.init_params(ks[1]),
+                "ln2": ln2.init_params(ks[2]),
+                "ff1": ff1.init_params(ks[3]),
+                "ff2": ff2.init_params(ks[4])}
+
+    def stage_fn(p, x):
+        ctx = OpContext(training=True, compute_dtype=jnp.float32)
+        h = ln1.forward(p["ln1"], [x], ctx)[0]
+        a = attn.forward(p["attn"], [h, h, h], ctx)[0]
+        x = x + a
+        h = ln2.forward(p["ln2"], [x], ctx)[0]
+        h = ff1.forward(p["ff1"], [h], ctx)[0]
+        h = ff2.forward(p["ff2"], [h], ctx)[0]
+        return x + h
+
+    return init_fn, stage_fn
 
 
 def stack_stage_params(per_stage_params):

@@ -1,0 +1,13 @@
+"""shard_map compatibility: jax >= 0.8 promotes it to jax.shard_map and
+renames check_rep -> check_vma; older jax keeps jax.experimental."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _new_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=True):
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
