@@ -30,6 +30,11 @@ def _torch():
     return torch
 
 
+def _pair(v):
+    """int-or-pair -> [h, w] (torch's pooling/conv argument convention)."""
+    return list(v) if isinstance(v, (tuple, list)) else [v, v]
+
+
 def _encoder_layer_cfg(layer) -> Dict[str, Any]:
     """Config of one nn.TransformerEncoderLayer (leaf-traced composite)."""
     act = getattr(layer, "activation", None)
@@ -88,8 +93,8 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
             k = mod.kernel_size
             s = mod.stride or k
             p = mod.padding
-            norm = lambda v: list(v) if isinstance(v, (tuple, list)) else [v, v]
-            cfg = dict(kernel_size=norm(k), stride=norm(s), padding=norm(p),
+            cfg = dict(kernel_size=_pair(k), stride=_pair(s),
+                       padding=_pair(p),
                        pool="max" if isinstance(mod, nn.MaxPool2d) else "avg")
         elif isinstance(mod, nn.BatchNorm2d):
             cfg = dict(num_features=mod.num_features)
@@ -474,6 +479,43 @@ class PyTorchModel:
                               + list(t.shape[axis:]), name=f"{name}_u{i}")
                    for i, t in enumerate(ts)]
             return ff.concat(ts2, axis, name=name)
+        if target in ("max_pool2d", "avg_pool2d"):
+            from flexflow_tpu.ffconst import PoolType
+
+            k = _pair(kwargs.get("kernel_size",
+                                 args[1] if len(args) > 1 else 2))
+            stride = kwargs.get("stride", args[2] if len(args) > 2 else None)
+            s_ = _pair(stride) if stride else k
+            p_ = _pair(kwargs.get("padding",
+                                  args[3] if len(args) > 3 else 0))
+            # arguments the backend pool has no analog for must fail
+            # loudly, not silently change numerics/shapes
+            dilation = kwargs.get("dilation",
+                                  args[4] if len(args) > 4 else 1)
+            ceil_mode = kwargs.get(
+                "ceil_mode", args[5] if target == "max_pool2d"
+                and len(args) > 5 else
+                (args[4] if target == "avg_pool2d" and len(args) > 4
+                 else False))
+            if (dilation not in (1, (1, 1), [1, 1]) or ceil_mode
+                    or kwargs.get("count_include_pad", True) is not True
+                    or kwargs.get("divisor_override") is not None):
+                raise NotImplementedError(
+                    f"{target}: dilation/ceil_mode/count_include_pad/"
+                    f"divisor_override have no translation")
+            pt = (PoolType.POOL_MAX if target == "max_pool2d"
+                  else PoolType.POOL_AVG)
+            return ff.pool2d(args[0], k[0], k[1], s_[0], s_[1], p_[0], p_[1],
+                             pool_type=pt, name=name)
+        if target == "adaptive_avg_pool2d":
+            out = kwargs.get("output_size",
+                             args[1] if len(args) > 1 else 1)
+            out = out if isinstance(out, (tuple, list)) else (out, out)
+            if tuple(out) != (1, 1):
+                raise NotImplementedError(
+                    "adaptive_avg_pool2d: only output_size (1,1) "
+                    "(global average pooling) translates")
+            return ff.mean(args[0], [2, 3], keepdims=True, name=name)
         if target == "layer_norm":
             ns = kwargs.get("normalized_shape",
                             args[1] if len(args) > 1 else None)

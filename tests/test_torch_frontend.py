@@ -246,3 +246,19 @@ class TestTransformerTracing:
         want = m(torch.from_numpy(x)).detach().numpy()
         got = ff.predict(x)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFunctionalPooling:
+    def test_functional_pools_match_torch(self):
+        class PoolNet(nn.Module):
+            def forward(self, x):
+                a = nn.functional.max_pool2d(torch.relu(x), 2, 2)
+                b = nn.functional.avg_pool2d(a, kernel_size=2)
+                return nn.functional.adaptive_avg_pool2d(b, 1).flatten(1)
+
+        m = PoolNet().eval()
+        ff, ptm, _ = build_ff(m, (3, 16, 16), batch=2)
+        x = np.random.RandomState(7).randn(2, 3, 16, 16).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = ff.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
