@@ -90,6 +90,14 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
                        stride=list(mod.stride), padding=list(mod.padding),
                        groups=mod.groups, bias=mod.bias is not None)
         elif isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+            if (getattr(mod, "ceil_mode", False)
+                    or getattr(mod, "dilation", 1) not in (1, (1, 1))
+                    or getattr(mod, "count_include_pad", True) is not True
+                    or getattr(mod, "divisor_override", None) is not None):
+                raise NotImplementedError(
+                    f"{type(mod).__name__}: ceil_mode/dilation/"
+                    f"count_include_pad/divisor_override have no "
+                    f"translation")
             k = mod.kernel_size
             s = mod.stride or k
             p = mod.padding
@@ -489,17 +497,27 @@ class PyTorchModel:
             p_ = _pair(kwargs.get("padding",
                                   args[3] if len(args) > 3 else 0))
             # arguments the backend pool has no analog for must fail
-            # loudly, not silently change numerics/shapes
-            dilation = kwargs.get("dilation",
-                                  args[4] if len(args) > 4 else 1)
-            ceil_mode = kwargs.get(
-                "ceil_mode", args[5] if target == "max_pool2d"
-                and len(args) > 5 else
-                (args[4] if target == "avg_pool2d" and len(args) > 4
-                 else False))
+            # loudly, not silently change numerics/shapes. Positional
+            # signatures differ: max_pool2d(..., dilation, ceil_mode) vs
+            # avg_pool2d(..., ceil_mode, count_include_pad, divisor)
+            if target == "max_pool2d":
+                dilation = kwargs.get("dilation",
+                                      args[4] if len(args) > 4 else 1)
+                ceil_mode = kwargs.get("ceil_mode",
+                                       args[5] if len(args) > 5 else False)
+                include_pad, divisor = True, None
+            else:
+                dilation = 1
+                ceil_mode = kwargs.get("ceil_mode",
+                                       args[4] if len(args) > 4 else False)
+                include_pad = kwargs.get(
+                    "count_include_pad",
+                    args[5] if len(args) > 5 else True)
+                divisor = kwargs.get(
+                    "divisor_override",
+                    args[6] if len(args) > 6 else None)
             if (dilation not in (1, (1, 1), [1, 1]) or ceil_mode
-                    or kwargs.get("count_include_pad", True) is not True
-                    or kwargs.get("divisor_override") is not None):
+                    or include_pad is not True or divisor is not None):
                 raise NotImplementedError(
                     f"{target}: dilation/ceil_mode/count_include_pad/"
                     f"divisor_override have no translation")
@@ -510,7 +528,7 @@ class PyTorchModel:
         if target == "adaptive_avg_pool2d":
             out = kwargs.get("output_size",
                              args[1] if len(args) > 1 else 1)
-            out = out if isinstance(out, (tuple, list)) else (out, out)
+            out = tuple(_pair(out))
             if tuple(out) != (1, 1):
                 raise NotImplementedError(
                     "adaptive_avg_pool2d: only output_size (1,1) "
