@@ -60,17 +60,27 @@ def _rebuild(skel, flat: Dict[str, Any], prefix=""):
 
 def save_checkpoint(path: str, ffmodel) -> None:
     """Write params + optimizer state + op state + iteration counter."""
+    from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
     state = {
         "params": ffmodel.params,
         "opt_state": ffmodel.opt_state,
-        "op_state": ffmodel.state,
+        # the bf16 working copy is derived from params — re-cast on load
+        # instead of doubling the checkpoint's parameter payload
+        "op_state": {k: v for k, v in ffmodel.state.items()
+                     if k != COMPUTE_PARAMS_KEY},
     }
     flat = _flatten(state)
     arrays = {}
     scalars = {}
     for k, v in flat:
         if hasattr(v, "shape"):
-            arrays[k] = np.asarray(v)
+            arr = np.asarray(v)
+            if arr.dtype.kind not in "fiub":
+                # np.savez writes non-native dtypes (ml_dtypes bfloat16)
+                # as raw void bytes that cannot load back — store as f32;
+                # load re-casts to the live leaf's dtype
+                arr = arr.astype(np.float32)
+            arrays[k] = arr
         else:
             scalars[k] = v
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
@@ -126,11 +136,18 @@ def load_checkpoint(path: str, ffmodel) -> int:
             if tuple(live.shape) != tuple(np.shape(new)):
                 raise ValueError(
                     f"checkpoint shape {np.shape(new)} != live {live.shape}")
-            return jax.device_put(new, live.sharding)
+            # cast to the live dtype (bf16 opt state is saved as f32)
+            import jax.numpy as jnp
+            return jax.device_put(jnp.asarray(new, live.dtype), live.sharding)
         return new
 
+    from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
+    live_op_state = {k: v for k, v in ffmodel.state.items()
+                     if k != COMPUTE_PARAMS_KEY}
     ffmodel.params = place(ffmodel.params, state["params"])
     ffmodel.opt_state = place(ffmodel.opt_state, state["opt_state"])
-    ffmodel.state = place(ffmodel.state, state["op_state"])
+    ffmodel.state = place(live_op_state, state["op_state"])
+    ffmodel._compute_params_dirty = True
+    ffmodel._refresh_compute_params()
     ffmodel._iter = int(manifest["iteration"])
     return ffmodel._iter

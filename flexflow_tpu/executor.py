@@ -27,6 +27,12 @@ from flexflow_tpu.metrics import Metrics
 from flexflow_tpu.ops.base import Op, OpContext
 
 
+# pseudo-entry in the op-state dict holding the bf16 parameter working
+# copy under the master-weight mixed-precision regime (never collides with
+# op names, which come from Layer naming)
+COMPUTE_PARAMS_KEY = "__compute_params__"
+
+
 class OpNode:
     """One materialized operator + where its inputs come from.
 
@@ -72,6 +78,18 @@ class GraphExecutor:
         self.compute_dtype = compute_dtype
         self.data_axes = data_axes
         self.final_is_softmax = final_is_softmax
+        # mixed-precision master-weight regime (bf16 compute): forward and
+        # backward run on a bf16 copy of the parameters that is produced
+        # INSIDE the previous step's optimizer fusion (state key
+        # '__compute_params__'), so the per-step f32->bf16 cast costs one
+        # extra bf16 write instead of an f32 read + bf16 write, gradients
+        # arrive in bf16 (halving the backward dW writes and any
+        # data-parallel gradient psum bytes), and the f32 master copy is
+        # touched only by the optimizer. Measured on v5e (r4,
+        # scripts/measure_flat_opt.py): the per-leaf update is already
+        # bandwidth-bound (~620 GB/s marginal), so byte reduction — not a
+        # flat-buffer layout — is the lever.
+        self.use_master_copy = compute_dtype != jnp.float32
         self._jit_train = None
         self._jit_eval = None
         self._jit_fwd = {}  # keyed by training flag
@@ -95,7 +113,24 @@ class GraphExecutor:
         for node in self.nodes:
             if hasattr(node.op, "init_state"):
                 state[node.op.name] = node.op.init_state()
+        if self.use_master_copy:
+            state[COMPUTE_PARAMS_KEY] = self.cast_compute_copy(params)
         return params, state
+
+    def cast_compute_copy(self, params):
+        """bf16 copy of the float parameter leaves (the forward/backward
+        working set under the master-weight regime)."""
+        if not hasattr(self, "_cast_jit"):
+            # cached: repeated refreshes (per-weight import loops) must not
+            # retrace a fresh jit each call
+            self._cast_jit = jax.jit(
+                lambda p: jax.tree.map(self._cast_leaf, p))
+        return self._cast_jit(params)
+
+    def _cast_leaf(self, x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
 
     def param_shardings(self, params):
         def spec_for(op_name, pname, arr):
@@ -173,6 +208,9 @@ class GraphExecutor:
         multi-step scans."""
 
         def train_step(params, opt_state, state, inputs, labels, rng):
+            cparams = (state[COMPUTE_PARAMS_KEY]
+                       if self.use_master_copy else params)
+
             def loss_fn(p):
                 ctx = OpContext(training=True, rng=rng,
                                 compute_dtype=self.compute_dtype,
@@ -186,11 +224,17 @@ class GraphExecutor:
 
             (loss, (logits, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params)
+            )(cparams)
             # gradient allreduce over data axes is inserted by GSPMD here
+            # (in bf16 under the master-weight regime — half the bytes)
             new_params, new_opt_state = self.optimizer.update(
                 grads, opt_state, params
             )
+            if self.use_master_copy:
+                # next step's bf16 working copy, fused into the update loop
+                # (one extra bf16 write instead of a separate cast pass)
+                new_state[COMPUTE_PARAMS_KEY] = jax.tree.map(
+                    self._cast_leaf, new_params)
             metric_vals = self.metrics.compute(logits, labels)
             return new_params, new_opt_state, new_state, loss, metric_vals
 

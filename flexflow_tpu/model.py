@@ -625,8 +625,15 @@ class FFModel:
         self._iter = 0
 
     # ======================= data staging ==================================
-    def _shard_batch(self, arr: np.ndarray) -> jax.Array:
-        return jax.device_put(jnp.asarray(arr), self.executor.batch_sharding())
+    def _shard_batch(self, arr: np.ndarray, cast: bool = False) -> jax.Array:
+        arr = jnp.asarray(arr)
+        if cast and jnp.issubdtype(arr.dtype, jnp.floating):
+            # activations flow in the compute dtype end-to-end (bf16 on
+            # TPU): ops emit outputs in their input dtype, so casting once
+            # at the graph boundary halves every activation's HBM traffic.
+            # Labels are staged without cast (loss math is f32).
+            arr = arr.astype(self.executor.compute_dtype)
+        return jax.device_put(arr, self.executor.batch_sharding())
 
     def _stage_inputs(self, xs) -> Dict[str, jax.Array]:
         if not isinstance(xs, (list, tuple)):
@@ -634,7 +641,7 @@ class FFModel:
         names = self.executor.input_names
         if len(xs) != len(names):
             raise ValueError(f"model has {len(names)} inputs, got {len(xs)} arrays")
-        return {n: self._shard_batch(x) for n, x in zip(names, xs)}
+        return {n: self._shard_batch(x, cast=True) for n, x in zip(names, xs)}
 
     # ======================= train / eval loops ============================
     def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
@@ -643,6 +650,7 @@ class FFModel:
         accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
         report. ``next_batch(epoch, b)`` -> (inputs dict, labels)."""
         train_step = self.executor.make_train_step()
+        self._refresh_compute_params()
         start = time.time()
         loss = None
         for epoch in range(epochs):
@@ -765,6 +773,7 @@ class FFModel:
     def update(self):
         inputs, labels = self._current_batch
         train_step = self.executor.make_train_step()
+        self._refresh_compute_params()
         self._rng, sub = jax.random.split(self._rng)
         (self.params, self.opt_state, self.state, self._last_loss, self._last_metrics) = \
             train_step(self.params, self.opt_state, self.state, inputs, labels, sub)
@@ -788,6 +797,23 @@ class FFModel:
             raise ValueError(f"shape mismatch {old.shape} vs {value.shape}")
         self.params[layer_name][param_name] = jax.device_put(
             jnp.asarray(value, old.dtype), old.sharding)
+        # defer the bf16 working-copy re-cast: per-weight import loops
+        # (torch/onnx/keras frontends) would otherwise cast the whole tree
+        # once per weight
+        self._compute_params_dirty = True
+
+    def _refresh_compute_params(self) -> None:
+        """Re-derive the bf16 working copy after direct params mutations
+        (set_parameter / checkpoint load / recompile carry-over) so the
+        next jitted step sees the new weights. Lazy: runs once before the
+        next use, however many mutations happened."""
+        from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
+        if not getattr(self, "_compute_params_dirty", False):
+            return
+        self._compute_params_dirty = False
+        if self.executor is not None and self.executor.use_master_copy:
+            self.state[COMPUTE_PARAMS_KEY] = \
+                self.executor.cast_compute_copy(self.params)
 
     def get_layer_names(self) -> List[str]:
         return [n.op.name for n in (self.executor.nodes if self.executor else [])]

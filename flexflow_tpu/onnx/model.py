@@ -164,6 +164,12 @@ class ONNXModel:
             self.initializers[name] = np.asarray(value)
             return None
         if op == "Gemm" or op == "MatMul":
+            if op == "Gemm" and at.get("transA", 0):
+                # dense computes x @ W; transposing the activation is not
+                # expressible as a weight fold — refuse rather than silently
+                # computing wrong numerics (advisor r3 finding)
+                raise NotImplementedError(
+                    f"Gemm node {name}: transA=1 is not supported")
             w = next((w for w in wts[1:] if w is not None and w.ndim == 2),
                      None)
             if w is not None:
@@ -172,12 +178,16 @@ class ONNXModel:
                 out_dim = kernel.shape[1]
                 bias = next((b for b in wts[1:]
                              if b is not None and b.ndim == 1), None)
+                # Gemm computes alpha*(A@B) + beta*C — fold both scalars
+                # into the imported weights so numerics match exactly
+                alpha = float(at.get("alpha", 1.0)) if op == "Gemm" else 1.0
+                beta = float(at.get("beta", 1.0)) if op == "Gemm" else 1.0
                 t = ff.dense(ins[0], int(out_dim),
                              use_bias=bias is not None, name=name)
                 imp = {"kernel": np.ascontiguousarray(kernel,
-                                                      dtype=np.float32)}
+                                                      dtype=np.float32) * alpha}
                 if bias is not None:
-                    imp["bias"] = np.asarray(bias, dtype=np.float32)
+                    imp["bias"] = np.asarray(bias, dtype=np.float32) * beta
                 self._imports[name] = imp
                 return done(t)
             # no initializer (dynamic weight or legacy stand-in): fall back

@@ -101,6 +101,9 @@ class MultiHeadAttention(Op):
         # head/attribute parallelism axis (set by the search when it picks a
         # "head" choice) so ring attention keeps heads sharded in shard_map
         self.head_parallel = p.get("head_parallel", None)
+        # batch-dim sharding (str or tuple of mesh axes under the sample2
+        # 'data+model' 2-D partition), recorded by apply_strategy
+        self.batch_parallel = p.get("batch_parallel", None)
         self.kernel_init = p.get("kernel_initializer") or DefaultWeightInitializer()
         super().__init__(layer, input_shapes)
 
@@ -151,6 +154,11 @@ class MultiHeadAttention(Op):
             v = jnp.repeat(v, rep, axis=1)
         rng = ctx.next_rng() if (self.dropout > 0 and ctx.training) else None
         dropout_rate = self.dropout if ctx.training else 0.0
+        # the attention core consumes q/k/v in the compute dtype (the
+        # projections accumulate in f32): softmax/accumulation inside every
+        # path below is f32 regardless, and bf16 kernel I/O halves the
+        # flash kernel's HBM traffic
+        q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
         seq_axis = self.seq_parallel
         mesh_axes = (dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))
                      if ctx.mesh is not None else {})
@@ -181,13 +189,22 @@ class MultiHeadAttention(Op):
                 if any(s > 1 for s in mesh_axes.values()):
                     # non-trivial mesh: the raw pallas_call would be an
                     # unpartitionable custom call under GSPMD — run it
-                    # per-shard via shard_map over the batch ('data') and,
+                    # per-shard via shard_map over the batch axes (possibly
+                    # the joint ('data','model') sample2 partition) and,
                     # when the search picked a head choice, the head axis
-                    batch_axis = ("data" if mesh_axes.get("data", 1) > 1
-                                  and q.shape[0] % mesh_axes["data"] == 0
+                    bp = getattr(self, "batch_parallel", None) or "data"
+                    bp = bp if isinstance(bp, tuple) else (bp,)
+                    bp = tuple(a for a in bp if mesh_axes.get(a, 1) > 1)
+                    bsz = int(np.prod([mesh_axes[a] for a in bp])) if bp else 1
+                    batch_axis = (bp if bp and q.shape[0] % bsz == 0
                                   else None)
+                    if batch_axis is not None and len(batch_axis) == 1:
+                        batch_axis = batch_axis[0]
                     hp = self.head_parallel
-                    head_axis = (hp if hp and mesh_axes.get(hp, 1) > 1
+                    in_batch = batch_axis if isinstance(batch_axis, tuple) \
+                        else (batch_axis,)
+                    head_axis = (hp if hp and hp not in in_batch
+                                 and mesh_axes.get(hp, 1) > 1
                                  and q.shape[1] % mesh_axes[hp] == 0
                                  else None)
                     o = flash_attention_sharded(

@@ -137,6 +137,14 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
                     node.op.seq_parallel = "seq"
                 if "head" in choice and axis_sizes.get("model", 1) > 1:
                     node.op.head_parallel = "model"
+                # record the batch-dim sharding (may be a tuple under the
+                # sample2 'data+model' 2-D partition) so the flash-attention
+                # shard_map keeps the joint sharding instead of forcing an
+                # all-gather over the model axis (advisor r3 finding)
+                spec0 = st.output_specs[0] if st.output_specs else None
+                if spec0:
+                    entries = list(spec0)
+                    node.op.batch_parallel = entries[0] if entries else None
             if (hasattr(node.op, "expert_parallel")
                     and choice.endswith("_ep")
                     and axis_sizes.get("expert", 1) > 1):

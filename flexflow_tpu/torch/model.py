@@ -35,6 +35,14 @@ def _pair(v):
     return list(v) if isinstance(v, (tuple, list)) else [v, v]
 
 
+def _section_sizes(length: int, per: int):
+    """torch split semantics: [per]*k plus a smaller final remainder chunk."""
+    sizes = [per] * (length // per)
+    if length % per:
+        sizes.append(length % per)
+    return sizes
+
+
 def _encoder_layer_cfg(layer) -> Dict[str, Any]:
     """Config of one nn.TransformerEncoderLayer (leaf-traced composite)."""
     act = getattr(layer, "activation", None)
@@ -472,11 +480,16 @@ class PyTorchModel:
             x = args[0]
             axis = kwargs.get("dim", args[2] if len(args) > 2 else 0)
             arg = args[1]
+            length = x.shape[axis]
             if target == "chunk":
-                sizes = int(arg)  # n equal chunks
-            else:  # split(size_or_sections, dim)
-                sizes = (list(arg) if isinstance(arg, (list, tuple))
-                         else max(1, x.shape[axis] // int(arg)))
+                # torch.chunk(n): chunk size ceil(len/n), smaller last chunk
+                n = int(arg)
+                per = -(-length // n)
+                sizes = _section_sizes(length, per)
+            elif isinstance(arg, (list, tuple)):
+                sizes = list(arg)
+            else:  # split(size, dim): [size]*k + [remainder]
+                sizes = _section_sizes(length, int(arg))
             return tuple(ff.split(x, sizes, axis, name=name))
         if target == "stack":
             ts = args[0]

@@ -198,6 +198,12 @@ inline std::vector<SubstRule> builtin_rules() {
     // work stays sharded (create_partition_conv2d_combine analog,
     // substitution.cc:1744): Combine(0,k) -> Conv/Pool/BN
     // => Conv/Pool/BN -> Combine(0,k)
+    // BATCHNORM note: under GSPMD a Combine/Repartition is a layout
+    // constraint, not data movement — BatchNorm's jnp.mean over the batch
+    // dim always computes GLOBAL-batch statistics (XLA inserts the
+    // cross-shard reduction when the dim is sharded), so this rewrite is
+    // numerics-preserving here, unlike a runtime that would compute
+    // per-shard local stats (advisor r3 finding: convention documented).
     for (const char* g : {"CONV2D", "POOL2D", "BATCHNORM", "LAYERNORM"}) {
       SubstRule r;
       r.name = std::string("move_combine_past_") + g;

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Find the chip's effective HBM bandwidth ceiling for elementwise streams
+and price Adam-update variants (f32 vs bf16 state) on the bench model size."""
+
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    float(jnp.sum(leaf.ravel()[:1]))
+
+
+N = 151_000_000  # bench-model param count
+
+
+def main():
+    x = jnp.arange(N, dtype=jnp.float32) * 1e-9
+    y = jnp.ones((N,), jnp.float32)
+
+    t = timeit(jax.jit(lambda a: a * 1.0001), x)
+    print(f"copy f32 (RW {8*N/1e9:.2f} GB):  {t*1e3:.3f} ms  {8*N/t/1e9:.0f} GB/s")
+
+    t = timeit(jax.jit(lambda a, b: a + 1.5 * b), x, y)
+    print(f"triad f32 (3x {4*N/1e9:.2f} GB): {t*1e3:.3f} ms  {12*N/t/1e9:.0f} GB/s")
+
+    xb = x.astype(jnp.bfloat16); yb = y.astype(jnp.bfloat16)
+    t = timeit(jax.jit(lambda a: a * jnp.bfloat16(1.0001)), xb)
+    print(f"copy bf16 (RW {4*N/1e9:.2f} GB): {t*1e3:.3f} ms  {4*N/t/1e9:.0f} GB/s")
+
+    # Adam variants at model scale: p f32; state m,v in f32 vs bf16
+    p = jnp.ones((N,), jnp.float32)
+    g = jnp.ones((N,), jnp.float32) * 1e-3
+
+    def adam(dt):
+        m = jnp.zeros((N,), dt); v = jnp.zeros((N,), dt)
+
+        def upd(g, m, v, p):
+            b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-4
+            gm = g.astype(jnp.float32)
+            mf = m.astype(jnp.float32); vf = v.astype(jnp.float32)
+            mn = b1 * mf + (1 - b1) * gm
+            vn = b2 * vf + (1 - b2) * gm * gm
+            pn = p - lr * mn / (jnp.sqrt(vn) + eps)
+            return mn.astype(dt), vn.astype(dt), pn
+
+        f = jax.jit(upd, donate_argnums=(1, 2, 3))
+        for _ in range(3):
+            m, v, p2 = f(g, m, v, p + 0)
+        _sync(p2)
+        p2 = p + 0
+        t0 = time.perf_counter()
+        for _ in range(20):
+            m, v, p2 = f(g, m, v, p2)
+        _sync(p2)
+        t = (time.perf_counter() - t0) / 20
+        sb = 2 if dt == jnp.bfloat16 else 4
+        moved = N * (4 * 3 + sb * 4)  # p R+W g R (f32) + m,v R+W (sb)
+        print(f"adam state={jnp.dtype(dt).name}: {t*1e3:.3f} ms  "
+              f"moved {moved/1e9:.2f} GB  {moved/t/1e9:.0f} GB/s")
+
+    adam(jnp.float32)
+    adam(jnp.bfloat16)
+
+    # grads in bf16 too (backward emits bf16): g R halves
+    def adam_bg():
+        m = jnp.zeros((N,), jnp.bfloat16); v = jnp.zeros((N,), jnp.bfloat16)
+        gb = g.astype(jnp.bfloat16)
+
+        def upd(g, m, v, p):
+            b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-4
+            gm = g.astype(jnp.float32)
+            mn = b1 * m.astype(jnp.float32) + (1 - b1) * gm
+            vn = b2 * v.astype(jnp.float32) + (1 - b2) * gm * gm
+            pn = p - lr * mn / (jnp.sqrt(vn) + eps)
+            return mn.astype(jnp.bfloat16), vn.astype(jnp.bfloat16), pn
+
+        f = jax.jit(upd, donate_argnums=(1, 2, 3))
+        p2 = p + 0
+        for _ in range(3):
+            m, v, p2 = f(gb, m, v, p2)
+        _sync(p2)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            m, v, p2 = f(gb, m, v, p2)
+        _sync(p2)
+        t = (time.perf_counter() - t0) / 20
+        moved = N * (4 * 2 + 2 + 2 * 4)
+        print(f"adam bf16 g+state: {t*1e3:.3f} ms  moved {moved/1e9:.2f} GB  "
+              f"{moved/t/1e9:.0f} GB/s")
+
+    adam_bg()
+
+
+if __name__ == "__main__":
+    main()

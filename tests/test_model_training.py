@@ -62,6 +62,32 @@ def test_mlp_adam_and_mse():
     assert ff.evaluate(x, y)["loss"] < 0.1
 
 
+def test_adam_bf16_state():
+    """Reduced-precision (bf16) m/v storage must converge like f32 state
+    (the bench's TPU-native optimizer configuration, bench.py)."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(128, 4).astype(np.float32)
+    w = rs.randn(4, 1).astype(np.float32)
+    y = x @ w
+
+    def run(state_dtype):
+        ff = FFModel(FFConfig(batch_size=32, seed=5))
+        t = ff.create_tensor((32, 4))
+        t = ff.dense(t, 16, activation=ActiMode.AC_MODE_TANH)
+        t = ff.dense(t, 1)
+        ff.compile(AdamOptimizer(alpha=0.01, state_dtype=state_dtype),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        ff.fit(x, y, epochs=20, verbose=False)
+        return ff.evaluate(x, y)["loss"]
+
+    loss_bf16 = run(jnp.bfloat16)
+    loss_f32 = run(None)
+    assert loss_bf16 < 0.1
+    assert abs(loss_bf16 - loss_f32) < 0.05
+
+
 def test_forward_backward_update_protocol():
     """Reference iteration protocol (flexflow_cffi.py:2073-2086)."""
     x, y = make_blobs(64, 8, 4)
