@@ -125,11 +125,15 @@ def main():
     protocol_changed = bool(entry) and entry.get("protocol",
                                                 PROTOCOL) != PROTOCOL
     try:
-        hist[workload] = {
-            "samples_per_s": max(samples_per_s, baseline or 0.0),
-            "protocol": PROTOCOL,
-            "config": dataclass_dict(cfg),
-        }
+        if samples_per_s >= (baseline or 0.0):
+            hist[workload] = {
+                "samples_per_s": samples_per_s,
+                "protocol": PROTOCOL,
+                "config": dataclass_dict(cfg),
+            }
+        # else: keep the stored best AND its provenance (protocol/config)
+        # untouched — stamping the current tags onto an old best would
+        # falsify the baseline's provenance
         json.dump(hist, open(hist_path, "w"))
     except Exception:
         pass
@@ -191,18 +195,30 @@ def searched_vs_dp_ratio(on_cpu):
         base_cfg = dict(budget=8, alpha=0.05, training=True, overlap=True,
                         batch=mcfg.batch_size, opt_state_factor=0.0,
                         seed=42, rules=[])
-        searched = native_optimize(dict(
+        # the searched arm gets the full strategy space, including the r4
+        # GPipe pipeline meshes (repeated-block metadata)
+        search_req = dict(
             nodes=nodes, machine=machine, measured={},
-            config=dict(base_cfg, enable_parameter_parallel=True)))
+            config=dict(base_cfg, enable_parameter_parallel=True))
+        from flexflow_tpu.parallel.pipeline_detect import (
+            detect_repeated_blocks, pipeline_meta_json)
+        pb = detect_repeated_blocks(ff.executor.nodes)
+        if pb is not None:
+            search_req["pipeline"] = pipeline_meta_json(ff.executor.nodes, pb)
+        searched = native_optimize(search_req)
         dp = native_optimize(dict(
             nodes=nodes, machine=machine, measured={},
             config=dict(base_cfg, only_data_parallel=True)))
         r = dp["predicted_time"] / searched["predicted_time"]
         mesh = {k: v for k, v in searched["mesh"].items() if v > 1}
-        return {
+        out = {
             "searched_vs_dp_v4_32": round(r, 3),
             "searched_mesh_v4_32": mesh or {"data": 1},
         }
+        if searched.get("pipeline"):
+            out["searched_microbatches_v4_32"] = \
+                searched["pipeline"]["microbatches"]
+        return out
     except Exception:
         return None
 

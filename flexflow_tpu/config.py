@@ -44,6 +44,10 @@ class FFConfig:
     search_overlap_backward_update: bool = False
     base_optimize_threshold: int = 10
     enable_substitution: bool = True  # graph-rewrite outer loop (GraphXfer)
+    # GPipe pipeline parallelism over a 'pipe' mesh axis on repeated-block
+    # graphs (r4; the reference only stubs OP_PIPELINE, ffconst.h:153)
+    enable_pipeline_parallel: bool = True
+    pipeline_microbatches: int = 0  # 0 = search over {1,2,4,8} * stages
     substitution_json: Optional[str] = None
     memory_search: bool = False
     memory_threshold_mb: Optional[int] = None
@@ -128,6 +132,10 @@ class FFConfig:
                 self.enable_attribute_parallel = True
             elif a == "--enable-sample-parallel":
                 self.enable_sample_parallel = True
+            elif a == "--disable-pipeline-parallel":
+                self.enable_pipeline_parallel = False
+            elif a == "--pipeline-microbatches":
+                self.pipeline_microbatches = int(take())
             elif a == "--search-num-nodes":
                 self.num_nodes = int(take())
             elif a == "--search-num-workers":

@@ -157,7 +157,16 @@ class GraphExecutor:
         values: Dict[Tuple[int, int], jax.Array] = {}
         new_state: Dict[str, Any] = {}
         aux_losses: List[jax.Array] = []
-        for node in self.nodes:
+        self._run_nodes(self.nodes, params, state, inputs, values,
+                        new_state, aux_losses, ctx)
+        return values, new_state, aux_losses
+
+    def _run_nodes(self, nodes, params, state, inputs, values, new_state,
+                   aux_losses, ctx: OpContext):
+        """Evaluate the given nodes in order, reading/writing the shared
+        ``values`` dict (lets the pipeline executor run head/tail subsets
+        around the shard_map'd body)."""
+        for node in nodes:
             op = node.op
             args = []
             for ref in node.input_refs:
@@ -185,7 +194,6 @@ class GraphExecutor:
                         o, NamedSharding(self.mesh, spec)
                     )
                 values[(op.guid, i)] = o
-        return values, new_state, aux_losses
 
     # ---- jitted steps ------------------------------------------------------
     def _loss_value(self, logits, labels):
@@ -241,6 +249,10 @@ class GraphExecutor:
         return train_step
 
     def make_train_step(self):
+        if getattr(self, "comp_mode", CompMode.TRAINING) == CompMode.INFERENCE:
+            raise RuntimeError(
+                "model compiled with CompMode.INFERENCE is forward-only; "
+                "re-compile with CompMode.TRAINING to train")
         if self._jit_train is None:
             self._jit_train = jax.jit(self._train_step_fn(),
                                       donate_argnums=(0, 1, 2))
@@ -258,6 +270,10 @@ class GraphExecutor:
         ``stacked=True``: each array carries a leading [num_iters] axis and
         iteration i consumes slice i.
         """
+        if getattr(self, "comp_mode", CompMode.TRAINING) == CompMode.INFERENCE:
+            raise RuntimeError(
+                "model compiled with CompMode.INFERENCE is forward-only; "
+                "re-compile with CompMode.TRAINING to train")
 
         step = self._train_step_fn()
 

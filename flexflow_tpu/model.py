@@ -408,6 +408,7 @@ class FFModel:
         assignment + jit.
         """
         cfg = self.config
+        cfg.computation_mode = comp_mode
         self.optimizer = optimizer or SGDOptimizer(
             lr=cfg.learning_rate, weight_decay=cfg.weight_decay)
         self.loss_type = loss_type
@@ -539,7 +540,9 @@ class FFModel:
                 # optimizer-state copies for the simulator's memory/update
                 # model: 0 plain SGD, 1 momentum, 2 Adam-family
                 from flexflow_tpu.optimizers import SGDOptimizer as _SGD
-                if isinstance(self.optimizer, _SGD):
+                if comp_mode == CompMode.INFERENCE:
+                    cfg.opt_state_factor = 0.0  # no optimizer state at all
+                elif isinstance(self.optimizer, _SGD):
                     cfg.opt_state_factor = (
                         1.0 if self.optimizer.momentum else 0.0)
                 else:
@@ -613,15 +616,45 @@ class FFModel:
             else jnp.float32
         )
         data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
-        self.executor = GraphExecutor(
-            nodes, input_names, final_ref, self.mesh, loss_type,
-            self.metrics, self.optimizer, compute_dtype=compute_dtype,
-            data_axes=data_axes,  # may be empty: batch replicated
-            final_is_softmax=self._final_is_softmax,
-        )
+        axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
+                           final_is_softmax=self._final_is_softmax)
+        if axes_now.get("pipe", 1) > 1:
+            # GPipe lowering: the search picked a pipe mesh (or the user
+            # passed one explicitly) — the repeated-block body executes as
+            # an SPMD pipeline (parallel/pipeline_exec.py)
+            from flexflow_tpu.parallel.pipeline_exec import (
+                PipelineGraphExecutor)
+            pinfo = (self.search_info or {}).get("pipeline") \
+                if isinstance(self.search_info, dict) else None
+            if pinfo is None or pinfo.get("blocks") is None:
+                from flexflow_tpu.parallel.pipeline_detect import (
+                    detect_repeated_blocks)
+                pb = detect_repeated_blocks(nodes)
+                if pb is None:
+                    raise ValueError(
+                        "mesh has a 'pipe' axis but the graph has no "
+                        "repeated-block body to pipeline")
+                pinfo = dict(blocks=pb,
+                             microbatches=cfg.pipeline_microbatches
+                             or 2 * axes_now["pipe"])
+            self.executor = PipelineGraphExecutor(
+                nodes, input_names, final_ref, self.mesh, loss_type,
+                self.metrics, self.optimizer,
+                pipe_blocks=pinfo["blocks"],
+                microbatches=int(pinfo.get("microbatches") or 0),
+                **exec_kwargs)
+        else:
+            self.executor = GraphExecutor(
+                nodes, input_names, final_ref, self.mesh, loss_type,
+                self.metrics, self.optimizer, **exec_kwargs)
+        self.executor.comp_mode = comp_mode
         self._rng, sub = jax.random.split(self._rng)
         self.params, self.state = self.executor.init_params_and_state(sub)
-        self.opt_state = self.optimizer.init(self.params)
+        # INFERENCE (ffconst.h:46 CompMode): forward-only executable — no
+        # optimizer state is ever allocated
+        self.opt_state = (None if comp_mode == CompMode.INFERENCE
+                          else self.optimizer.init(self.params))
         self._iter = 0
 
     # ======================= data staging ==================================
@@ -787,11 +820,35 @@ class FFModel:
         pass
 
     # ---- weight I/O (parallel_tensor.h:164-169 set_tensor/get_tensor) -----
+    def _body_ref(self, layer_name: str):
+        """(template_key, block_idx) when layer_name is a pipelined body op."""
+        m = getattr(self.executor, "body_param_map", None)
+        return m.get(layer_name) if m else None
+
     def get_parameter(self, layer_name: str, param_name: str = "kernel") -> np.ndarray:
+        ref = self._body_ref(layer_name)
+        if ref is not None:
+            from flexflow_tpu.parallel.pipeline_exec import BODY_KEY
+            key, b = ref
+            return np.asarray(self.params[BODY_KEY][key][param_name][b])
         return np.asarray(self.params[layer_name][param_name])
 
     def set_parameter(self, layer_name: str, value: np.ndarray,
                       param_name: str = "kernel") -> None:
+        ref = self._body_ref(layer_name)
+        if ref is not None:
+            from flexflow_tpu.parallel.pipeline_exec import BODY_KEY
+            key, b = ref
+            old = self.params[BODY_KEY][key][param_name]
+            if tuple(old.shape[1:]) != tuple(value.shape):
+                raise ValueError(
+                    f"shape mismatch {old.shape[1:]} vs {value.shape}")
+            # device-side slice update: keeps the pipe sharding and avoids
+            # a full host round-trip of the stacked [R, ...] array per call
+            self.params[BODY_KEY][key][param_name] = old.at[b].set(
+                jnp.asarray(value, old.dtype))
+            self._compute_params_dirty = True
+            return
         old = self.params[layer_name][param_name]
         if tuple(old.shape) != tuple(value.shape):
             raise ValueError(f"shape mismatch {old.shape} vs {value.shape}")

@@ -28,13 +28,17 @@ from flexflow_tpu.utils.shard_map_compat import shard_map
 
 
 def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
-                  axis: str = "pipe", data_axis: str = "data"):
+                  axis: str = "pipe", data_axis: str = "data",
+                  stage_leading_dim: bool = False):
     """Run ``stage_fn`` as an S-stage GPipe pipeline.
 
     stage_fn(params_slice, x) -> y: one stage's computation; input and
         output must share shape/dtype (repeated-block models).
-    stacked_params: pytree with leading dim S == mesh axis size, sharded
-        over ``axis``.
+    stacked_params: pytree with leading dim R (a multiple of the ``axis``
+        mesh size S), sharded over ``axis``. With R == S each stage holds
+        one slice; ``stage_leading_dim=True`` keeps the local [R/S, ...]
+        leading dim and hands the whole local tree to stage_fn (a stage
+        running R/S blocks); False (default) squeezes it (R must equal S).
     x: [B, ...] global batch; B % num_microbatches == 0, and the
         microbatch size is the unit each stage processes per tick. When
         ``data_axis`` names a mesh axis, each microbatch additionally
@@ -51,7 +55,9 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = sizes[axis]
     for leaf in jax.tree.leaves(stacked_params):
-        if leaf.shape[0] != S:
+        bad = (leaf.shape[0] % S != 0) if stage_leading_dim \
+            else (leaf.shape[0] != S)
+        if bad:
             raise ValueError(
                 f"stacked param dim 0 is {leaf.shape[0]} but the '{axis}' "
                 f"mesh axis has {S} stages — a mismatch would silently "
@@ -66,9 +72,11 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
             f"({sizes[data_axis]}) != 0")
 
     def body(params, xs):
-        # params: [1, ...] this device's stage; xs: [M, B/M, ...] (replicated)
+        # params: [R/S, ...] this device's stage; xs: [M, B/M, ...]
+        # (replicated over pipe)
         idx = jax.lax.axis_index(axis)
-        p = jax.tree.map(lambda w: w[0], params)
+        p = params if stage_leading_dim \
+            else jax.tree.map(lambda w: w[0], params)
         mb = xs.shape[1]
         state = jnp.zeros((mb,) + xs.shape[2:], xs.dtype)  # in-flight act
         outs = jnp.zeros_like(xs)

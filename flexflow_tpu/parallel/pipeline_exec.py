@@ -1,0 +1,183 @@
+"""Pipeline-parallel graph executor: FFModel.compile's lowering when the
+search (or an explicit mesh) picks a 'pipe' axis.
+
+Completes the capability the reference only stubs (OP_PIPELINE,
+/root/reference/include/flexflow/ffconst.h:153): the repeated-block body
+of the graph executes as an SPMD GPipe pipeline (parallel/pipeline.py)
+while head/tail ops run under ordinary GSPMD around it. Body parameters
+live STACKED — params['__pipe_body__']['op<j>'] with leading dim
+R = num_blocks sharded over 'pipe' — so each device holds only its
+stage's R/S block slices (1/pp of the body weights, matching the native
+search's memory model, native/ffs_sim.hpp simulate_pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.executor import COMPUTE_PARAMS_KEY, GraphExecutor
+from flexflow_tpu.ops.base import OpContext
+
+BODY_KEY = "__pipe_body__"
+
+
+class PipelineGraphExecutor(GraphExecutor):
+    def __init__(self, *args, pipe_blocks=None, microbatches: int = 0,
+                 pipe_axis: str = "pipe", **kwargs):
+        super().__init__(*args, **kwargs)
+        if pipe_blocks is None:
+            raise ValueError("PipelineGraphExecutor needs detected blocks")
+        self.pb = pipe_blocks
+        self.pipe_axis = pipe_axis
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.num_stages = sizes.get(pipe_axis, 1)
+        R = self.pb.num_blocks
+        if self.num_stages < 2:
+            raise ValueError("mesh has no 'pipe' axis > 1")
+        if R % self.num_stages:
+            raise ValueError(
+                f"{R} repeated blocks cannot split into "
+                f"{self.num_stages} pipeline stages")
+        self.microbatches = microbatches or 2 * self.num_stages
+        batch = None
+        for ni in self.pb.blocks[0]:
+            batch = self.nodes[ni].op.output_shapes[0][0]
+            break
+        dp = sizes.get("data", 1)
+        if batch is not None and batch % (self.microbatches * dp):
+            raise ValueError(
+                f"batch {batch} must divide microbatches*data "
+                f"({self.microbatches}*{dp})")
+        for blk in self.pb.blocks:
+            for ni in blk:
+                op = self.nodes[ni].op
+                # backstop — detection already refuses these
+                # (pipeline_detect.stateless); a mismatch here means the
+                # blocks came from somewhere else
+                if getattr(op, "dropout", 0.0) or hasattr(op, "init_state"):
+                    raise ValueError(
+                        f"op '{op.name}': dropout/stateful ops inside "
+                        f"pipelined blocks are not supported by the GPipe "
+                        f"lowering yet")
+        self._head = [self.nodes[i] for i in self.pb.head]
+        self._tail = [self.nodes[i] for i in self.pb.tail]
+        # map full op name -> (template param key, block index) for the
+        # per-layer weight I/O API (FFModel.get/set_parameter)
+        self.body_param_map: Dict[str, tuple] = {}
+        for b, blk in enumerate(self.pb.blocks):
+            for j, ni in enumerate(blk):
+                self.body_param_map[self.nodes[ni].op.name] = (f"op{j}", b)
+
+    # ---- parameters -------------------------------------------------------
+    def init_params_and_state(self, rng):
+        def _init(rng):
+            p: Dict[str, Any] = {}
+            for node in self._head + self._tail:
+                rng, sub = jax.random.split(rng)
+                ps = node.op.init_params(sub)
+                if ps:
+                    p[node.op.name] = ps
+            per_block: List[Dict] = []
+            for blk in self.pb.blocks:
+                bp = {}
+                for j, ni in enumerate(blk):
+                    rng, sub = jax.random.split(rng)
+                    ps = self.nodes[ni].op.init_params(sub)
+                    if ps:
+                        bp[f"op{j}"] = ps
+                per_block.append(bp)
+            p[BODY_KEY] = jax.tree.map(lambda *ws: jnp.stack(ws), *per_block)
+            return p
+
+        params = jax.jit(_init)(rng)
+        params = jax.device_put(params, self.param_shardings(params))
+        state: Dict[str, Any] = {}
+        for node in self._head + self._tail:
+            if hasattr(node.op, "init_state"):
+                state[node.op.name] = node.op.init_state()
+        if self.use_master_copy:
+            state[COMPUTE_PARAMS_KEY] = self.cast_compute_copy(params)
+        return params, state
+
+    def param_shardings(self, params):
+        by_name = {n.op.name: n for n in self.nodes}
+
+        def head_tail(op_name, sub):
+            node = by_name[op_name]
+            return {
+                pn: NamedSharding(self.mesh, node.param_specs.get(pn, P()))
+                for pn in sub
+            }
+
+        out = {}
+        for op_name, sub in params.items():
+            if op_name == BODY_KEY:
+                out[BODY_KEY] = jax.tree.map(
+                    lambda w: NamedSharding(
+                        self.mesh,
+                        P(self.pipe_axis, *([None] * (w.ndim - 1)))),
+                    sub)
+            else:
+                out[op_name] = head_tail(op_name, sub)
+        return out
+
+    # ---- body execution ---------------------------------------------------
+    def _run_block_template(self, pblock, x, ctx: OpContext):
+        """One block's ops (block-0 structure) on params slice ``pblock``."""
+        tmpl = self.pb.blocks[0]
+        values = {}
+        y = None
+        for j, ni in enumerate(tmpl):
+            node = self.nodes[ni]
+            args = []
+            for ref in node.input_refs:
+                key = (ref[1], ref[2]) if ref[0] == "op" else None
+                if key is not None and key in values:
+                    args.append(values[key])
+                else:
+                    args.append(x)  # block boundary input
+            outs = node.op.forward(pblock.get(f"op{j}", {}), args, ctx)
+            for oi, o in enumerate(outs):
+                values[(node.op.guid, oi)] = o
+        # block boundary: the TEMPLATE's last node, at body_out's out_idx
+        # (body_out itself names the LAST block's node)
+        last_guid = self.nodes[tmpl[-1]].op.guid
+        return values[(last_guid, self.pb.body_out[2])]
+
+    def _stage_fn(self, training: bool):
+        k = self.pb.num_blocks // self.num_stages
+        ctx = OpContext(training=training, compute_dtype=self.compute_dtype)
+
+        def stage_fn(p_local, x):
+            for b in range(k):
+                pb = jax.tree.map(lambda w: w[b], p_local)
+                x = self._run_block_template(pb, x, ctx)
+            return x
+
+        return stage_fn
+
+    # ---- graph traversal (head -> pipeline -> tail) -----------------------
+    def run_graph(self, params, state, inputs, ctx: OpContext):
+        from flexflow_tpu.parallel.pipeline import pipeline_spmd
+
+        values: Dict = {}
+        new_state: Dict[str, Any] = {}
+        aux: List = []
+        self._run_nodes(self._head, params, state, inputs, values,
+                        new_state, aux, ctx)
+        if self.pb.body_in[0] == "input":
+            x = inputs[self.pb.body_in[1]]
+        else:
+            x = values[(self.pb.body_in[1], self.pb.body_in[2])]
+        y = pipeline_spmd(
+            self._stage_fn(ctx.training), params[BODY_KEY], x, self.mesh,
+            num_microbatches=self.microbatches, axis=self.pipe_axis,
+            data_axis="data", stage_leading_dim=True)
+        values[(self.pb.body_out[1], self.pb.body_out[2])] = y
+        self._run_nodes(self._tail, params, state, inputs, values,
+                        new_state, aux, ctx)
+        return values, new_state, aux

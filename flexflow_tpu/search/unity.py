@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 from jax.sharding import PartitionSpec as P
 
-from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ffconst import CompMode, OperatorType
 from flexflow_tpu.ops.base import DimRole
 from flexflow_tpu.parallel.strategy import OpStrategy, Strategy
 
@@ -90,7 +90,8 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
     return out
 
 
-def machine_to_json(spec, num_devices: int) -> Dict[str, Any]:
+def machine_to_json(spec, num_devices: int,
+                    comm_bytes_factor: float = 1.0) -> Dict[str, Any]:
     return dict(
         num_devices=num_devices,
         flops=spec.flops,
@@ -103,6 +104,9 @@ def machine_to_json(spec, num_devices: int) -> Dict[str, Any]:
         num_slices=spec.num_slices,
         mxu_efficiency=getattr(spec, "mxu_efficiency", 0.55),
         min_op_time=getattr(spec, "min_op_time", 5e-7),
+        # bf16 activations/grads under mixed precision: collectives move
+        # half the nominal f32 bytes (ffs_machine.hpp comm_bytes_factor)
+        comm_bytes_factor=comm_bytes_factor,
     )
 
 
@@ -198,9 +202,15 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
         threshold = config.memory_threshold_mb * (1 << 20)
     elif config.memory_search:
         threshold = config.memory_per_chip_mb * (1 << 20)
+    # mixed precision (TPU): activations + grads move in bf16 — halve the
+    # collective payloads the cost model prices (matches the executor's
+    # master-weight regime; CPU/f32 machines keep 1.0)
+    comm_factor = 0.5 if (getattr(config, "allow_mixed_precision", True)
+                          and machine_spec.chip != "cpu-sim") else 1.0
     request = dict(
         nodes=serialize_graph(nodes),
-        machine=machine_to_json(machine_spec, num_devices),
+        machine=machine_to_json(machine_spec, num_devices,
+                                comm_bytes_factor=comm_factor),
         config=dict(
             budget=config.search_budget,
             alpha=config.search_alpha,
@@ -208,7 +218,10 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             enable_parameter_parallel=config.enable_parameter_parallel
                 or config.enable_attribute_parallel,
             overlap=config.search_overlap_backward_update,
-            training=True,
+            # CompMode.INFERENCE (ffconst.h:46): forward-only cost model —
+            # no backward tasks, no gradient sync, no opt-state memory
+            training=getattr(config, "computation_mode",
+                             CompMode.TRAINING) == CompMode.TRAINING,
             memory_threshold=threshold,
             seed=config.seed,
             batch=batch,
@@ -219,9 +232,22 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # optimizer-state copies (0 SGD / 1 momentum / 2 Adam), set by
             # FFModel.compile from the actual optimizer
             opt_state_factor=getattr(config, "opt_state_factor", 2.0),
+            enable_pipeline_parallel=getattr(
+                config, "enable_pipeline_parallel", True),
+            pipeline_microbatches=getattr(
+                config, "pipeline_microbatches", 0),
         ),
         measured=measured or {},
     )
+    # repeated-block pipeline metadata: lets the native search enumerate
+    # 'pipe' meshes (GPipe cost model, native/ffs_sim.hpp)
+    pipe_blocks = None
+    if getattr(config, "enable_pipeline_parallel", True):
+        from flexflow_tpu.parallel.pipeline_detect import (
+            detect_repeated_blocks, pipeline_meta_json)
+        pipe_blocks = detect_repeated_blocks(nodes)
+        if pipe_blocks is not None:
+            request["pipeline"] = pipeline_meta_json(nodes, pipe_blocks)
     if subst_rules is not None:
         request["subst_rules"] = subst_rules
     if final_ref is not None:
@@ -256,6 +282,12 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 predicted_memory=resp.get("predicted_memory"),
                 stats=resp.get("stats", {}),
                 rewrites=resp.get("rewrites", []))
+    if resp.get("pipeline") and mesh_axes.get("pipe", 1) > 1:
+        # the search picked a GPipe strategy: hand compile() what the
+        # lowering onto pipeline_spmd needs (rewrites never fire together
+        # with pipe meshes — block identity would break — so the detected
+        # blocks are still valid for new_nodes == nodes)
+        info["pipeline"] = dict(resp["pipeline"], blocks=pipe_blocks)
     if new_nodes is not nodes:
         info["rewritten_nodes"] = new_nodes
         info["final_ref"] = new_final

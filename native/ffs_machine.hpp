@@ -31,6 +31,12 @@ struct MachineModel {
   int num_slices = 1;
   double mxu_efficiency = 0.55;  // achievable fraction of peak on real shapes
   double min_op_time = 5e-7;     // floor per fused op (dispatch overhead)
+  // Collective payloads relative to the graph's nominal dtype: under the
+  // r4 mixed-precision regime activations AND gradients move in bf16
+  // while tensors are declared f32, so every collective's bytes halve
+  // (0.5). Validated against emitted HLO (tests/test_collective_validation
+  // runs f32/CPU where this stays 1.0).
+  double comm_bytes_factor = 1.0;
 
   static MachineModel from_json(const Json& j) {
     MachineModel m;
@@ -45,6 +51,8 @@ struct MachineModel {
     m.num_slices = static_cast<int>(j.get("num_slices").as_int(1));
     m.mxu_efficiency = j.get("mxu_efficiency").as_double(m.mxu_efficiency);
     m.min_op_time = j.get("min_op_time").as_double(m.min_op_time);
+    m.comm_bytes_factor =
+        j.get("comm_bytes_factor").as_double(m.comm_bytes_factor);
     return m;
   }
 
@@ -53,18 +61,21 @@ struct MachineModel {
 
   // Ring all-reduce of `bytes` over `k` chips: 2(k-1)/k * B / bw.
   double allreduce_time(double bytes, int k) const {
+    bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
     return ici_latency * (k - 1) + 2.0 * (k - 1) / k * bytes / ring_bw();
   }
 
   // All-gather producing `bytes` full output on each of `k` chips.
   double allgather_time(double bytes, int k) const {
+    bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
     return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
   }
 
   // Reduce-scatter of `bytes` over `k` chips.
   double reducescatter_time(double bytes, int k) const {
+    bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
     return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
   }
@@ -72,18 +83,21 @@ struct MachineModel {
   // One full ring rotation (ring attention K/V pass): `bytes` total sent
   // per chip over k-1 neighbor hops on one ICI link direction.
   double ring_time(double bytes, int k) const {
+    bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
     return ici_latency * (k - 1) + bytes / ici_bw;
   }
 
   // All-to-all: each chip exchanges its (bytes/k) shard with k-1 peers.
   double alltoall_time(double bytes, int k) const {
+    bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
     return ici_latency + bytes * (k - 1) / k / k / ring_bw();
   }
 
   // Cross-slice (DCN) all-reduce of `bytes` across num_slices.
   double dcn_allreduce_time(double bytes) const {
+    bytes *= comm_bytes_factor;
     if (num_slices <= 1 || bytes <= 0) return 0.0;
     return dcn_latency * (num_slices - 1) +
            2.0 * (num_slices - 1) / num_slices * bytes / dcn_bw;
@@ -99,12 +113,14 @@ struct MachineModel {
   // standard multislice gradient sync (NetworkedMachineModel's role,
   // reference simulator.h:515, re-expressed for the TPU slice topology).
   double hier_allreduce_time(double bytes, int k, int slices) const {
+    // NOTE: delegates to allreduce_time, which applies comm_bytes_factor —
+    // only the DCN term scales locally (no double scaling)
     if (k <= 1 || bytes <= 0) return 0.0;
     slices = std::max(1, std::min(slices, num_slices));
     if (slices <= 1) return allreduce_time(bytes, k);
     int k_inner = std::max(1, k / slices);
     double t = allreduce_time(bytes, k_inner);
-    double shard = bytes / k_inner;
+    double shard = bytes * comm_bytes_factor / k_inner;
     t += dcn_latency * (slices - 1) +
          2.0 * (slices - 1) / slices * shard / dcn_bw;
     return t;

@@ -55,6 +55,8 @@ struct SearchConfig {
   int64_t batch = 0;  // global batch size; dp must divide it (0 = unconstrained)
   bool enable_substitution = true;  // graph-rewrite outer loop
   bool enable_sample_parallel = true;  // 2-D batch partition (config.h:134)
+  bool enable_pipeline_parallel = true;  // GPipe over a 'pipe' axis (r4)
+  int pipeline_microbatches = 0;    // 0 = auto (search over {1,2,4,8}*pp)
   int subst_budget = 0;             // best-first expansions (0 = from budget)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
@@ -73,6 +75,8 @@ struct SearchConfig {
     c.batch = j.get("batch").as_int(0);
     c.enable_substitution = j.get("enable_substitution").as_bool(true);
     c.enable_sample_parallel = j.get("enable_sample_parallel").as_bool(true);
+    c.enable_pipeline_parallel = j.get("enable_pipeline_parallel").as_bool(true);
+    c.pipeline_microbatches = (int)j.get("pipeline_microbatches").as_int(0);
     c.subst_budget = (int)j.get("subst_budget").as_int(
         std::max(1, std::min(c.budget, 16)));
     for (const Json& r : j.get("rules").items()) {
@@ -129,6 +133,11 @@ struct DPState {
   std::vector<Spec> frontier;
   double cost = 0;
   double memory = 0;
+  // liveness accounting (inference: activations free at last use, so the
+  // metric is peak live + params — the bump-allocator role of reference
+  // simulator.h:699-700; training keeps the saved-residual sum in
+  // `memory` directly)
+  double act_live = 0, act_peak = 0, param_mem = 0;
   Assignment assign;
 
   std::string key() const {
@@ -194,9 +203,26 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
       }
     uses = std::move(uses_after);
 
+    // keep-mask for the liveness free computation (per boundary, not per
+    // choice): positions of `live` NOT carried into next_live
+    std::vector<char> kept_mask(live.size(), 0);
+    for (int p : keep_pos) kept_mask[p] = 1;
+
     std::map<std::string, DPState> next;
     double best_cost = 1e30;
     for (const DPState& st : states) {
+      // bytes freed when this node consumes its inputs' last use —
+      // depends on the state's frontier specs only, hoisted out of the
+      // choice loop
+      double st_dropped = 0;
+      if (!cfg.training) {
+        for (size_t p = 0; p < live.size(); ++p) {
+          if (kept_mask[p]) continue;
+          int pi2 = g.index_of.at(live[p].first);
+          st_dropped += (double)g.nodes[pi2].output_bytes(live[p].second) /
+                        shards_of(st.frontier[p], mesh);
+        }
+      }
       for (size_t ci = 0; ci < choices[i].size(); ++ci) {
         const Choice& c = choices[i][ci];
         double cost = st.cost;
@@ -213,13 +239,24 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
         }
         NodeCost nc = node_cost(n, c, mesh, m, cfg.training, measured);
         cost += nc.total();
-        double mem = node_memory(n, c, mesh, cfg.opt_state_factor);
-        cost += lambda * mem;
+        double pmem = node_param_memory(n, c, mesh, cfg.opt_state_factor);
+        double amem = node_act_bytes(n, c, mesh);
+        cost += lambda * (pmem + amem);
         DPState ns;
         ns.cost = cost;
-        ns.memory = st.memory + mem;
         ns.assign = st.assign;
         ns.assign.push_back(static_cast<int>(ci));
+        if (cfg.training) {
+          // every activation is a saved residual: the sum is the peak
+          ns.memory = st.memory + pmem + amem;
+        } else {
+          // inference: activations free at their last consumer
+          ns.param_mem = st.param_mem + pmem;
+          double live_b = st.act_live + amem;
+          ns.act_peak = std::max(st.act_peak, live_b);
+          ns.act_live = live_b - st_dropped;
+          ns.memory = ns.param_mem + ns.act_peak;
+        }
         ns.frontier.reserve(next_live.size());
         for (int p : keep_pos) ns.frontier.push_back(st.frontier[p]);
         for (int oi : new_out) ns.frontier.push_back(c.out[oi]);
@@ -348,11 +385,26 @@ Assignment mcmc_refine(const Graph& g, const std::vector<std::vector<Choice>>& c
 
 // ---- per-graph evaluation (mesh loop + DP [+ MCMC]) -----------------------
 
+PipelineMeta pipeline_meta_from_json(const Json& j) {
+  PipelineMeta p;
+  if (j.is_null()) return p;
+  p.num_blocks = (int)j.get("num_blocks").as_int(0);
+  if (p.num_blocks < 2) return p;
+  for (const Json& v : j.get("body").items()) p.body.insert(v.as_int());
+  for (const Json& v : j.get("head").items()) p.head.insert(v.as_int());
+  for (const Json& v : j.get("tail").items()) p.tail.insert(v.as_int());
+  p.block_out_bytes = j.get("block_out_bytes").as_double(0);
+  p.batch = j.get("batch").as_int(0);
+  p.present = p.num_blocks >= 2 && !p.body.empty();
+  return p;
+}
+
 // Outer mesh-shape enumeration (MachineView enumeration analog) — N-D:
-// every (data, model, seq, expert) factorization of the chip count legal
-// for this graph's seq extent / expert count.
+// every (data, model, seq, expert[, pipe]) factorization of the chip count
+// legal for this graph's seq extent / expert count / repeated-block count.
 std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
-                                        const SearchConfig& cfg) {
+                                        const SearchConfig& cfg,
+                                        const PipelineMeta& pipe = {}) {
   int64_t seq_extent = 0;
   int64_t num_experts = 0;
   for (const Node& n : g.nodes) {
@@ -379,18 +431,31 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
         if (ep > 1 && (cfg.only_data_parallel || num_experts % ep ||
                        num_experts <= 1))
           continue;
-        int dp = N / mp / sp / ep;
-        // the host stages the batch sharded over 'data': dp must divide it
-        if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
-        // multislice: model/seq/expert collectives are latency-bound and
-        // must stay inside one ICI domain; only the data (gradient) axis
-        // may span slices over DCN (priced by hier_allreduce_time)
-        if (m.num_slices > 1) {
-          int inner = mp * sp * ep;
-          if (inner > m.chips_per_slice() || m.chips_per_slice() % inner)
+        int rem = N / mp / sp / ep;
+        // pipe axis: only on repeated-block graphs, composed with dp only
+        // (the pipeline lowering runs stages under shard_map over
+        // {pipe, data}; model/seq/expert inside a stage is future work)
+        for (int pp = 1; pp <= rem; ++pp) {
+          if (rem % pp) continue;
+          if (pp > 1 &&
+              (cfg.only_data_parallel || !cfg.enable_pipeline_parallel ||
+               !pipe.present || pipe.num_blocks % pp ||
+               mp * sp * ep != 1))
             continue;
+          int dp = rem / pp;
+          // the host stages the batch sharded over 'data': dp must divide
+          // it (under pipe: each microbatch shards over dp too)
+          if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+          // multislice: model/seq/expert collectives are latency-bound and
+          // must stay inside one ICI domain; only the data (gradient) axis
+          // and the point-to-point pipe hops may cross slices
+          if (m.num_slices > 1) {
+            int inner = mp * sp * ep;
+            if (inner > m.chips_per_slice() || m.chips_per_slice() % inner)
+              continue;
+          }
+          meshes.push_back({dp, mp, sp, ep, pp});
         }
-        meshes.push_back({dp, mp, sp, ep});
       }
     }
   }
@@ -405,18 +470,57 @@ struct GraphEval {
   std::vector<std::vector<Choice>> choices;
   SimResult sim;
   int64_t states = 0;
+  int pipe_microbatches = 0;  // > 0 when mesh.pp > 1
 };
 
 GraphEval eval_graph(const Graph& g, const MachineModel& m,
                      const SearchConfig& cfg, double threshold,
                      const MeasuredCosts& measured, bool refine,
-                     MCMCStats* mcmc) {
+                     MCMCStats* mcmc, const PipelineMeta& pipe = {}) {
   GraphEval ev;
-  for (const MeshShape& mesh : enumerate_meshes(g, m, cfg)) {
+  for (const MeshShape& mesh : enumerate_meshes(g, m, cfg, pipe)) {
     auto choices = all_choices(g, mesh, cfg);
-    DPResult dp = dp_with_memory(g, choices, mesh, m, cfg, threshold, &measured);
+    // pp>1: the DP's memory model has no pipe axis (it would see every
+    // chip holding all blocks and prune exactly the configs pipelining
+    // exists to fit) — run unconstrained and let simulate_pipeline's
+    // 1/pp-aware memory check enforce the threshold
+    DPResult dp = mesh.pp > 1
+        ? frontier_dp(g, choices, mesh, m, cfg, 0.0, &measured)
+        : dp_with_memory(g, choices, mesh, m, cfg, threshold, &measured);
     ev.states += dp.states;
     if (!dp.ok) continue;
+    std::vector<Choice> cs0;
+    for (size_t i = 0; i < dp.assign.size(); ++i)
+      cs0.push_back(choices[i][dp.assign[i]]);
+    if (mesh.pp > 1) {
+      // GPipe wrapper around the inner-mesh DP result; pick the best
+      // microbatch count (more microbatches shrink the bubble but also
+      // the per-tick tile efficiency, captured by the per-op floor)
+      std::vector<int> mcands;
+      if (cfg.pipeline_microbatches > 0) {
+        mcands.push_back(cfg.pipeline_microbatches);
+      } else {
+        for (int f : {1, 2, 4, 8}) mcands.push_back(f * mesh.pp);
+      }
+      for (int M : mcands) {
+        if (M < 1) continue;
+        int64_t b = cfg.batch > 0 ? cfg.batch : pipe.batch;
+        if (b > 0 && (b % ((int64_t)M * std::max(1, mesh.dp)))) continue;
+        SimResult sr = simulate_pipeline(g, m, mesh, cs0, pipe, cfg.training,
+                                         cfg.opt_state_factor, &measured, M);
+        if (threshold > 0 && sr.memory > threshold) continue;
+        if (sr.iteration_time < ev.time) {
+          ev.time = sr.iteration_time;
+          ev.mesh = mesh;
+          ev.assign = dp.assign;
+          ev.choices = choices;
+          ev.sim = sr;
+          ev.ok = true;
+          ev.pipe_microbatches = M;
+        }
+      }
+      continue;
+    }
     TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
                            cfg.opt_state_factor, &measured);
     Assignment a = dp.assign;
@@ -433,6 +537,7 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
       ev.choices = choices;
       ev.sim = sr;
       ev.ok = true;
+      ev.pipe_microbatches = 0;
     }
   }
   return ev;
@@ -468,10 +573,14 @@ Json optimize(const Json& req) {
     final_ref = {fj[0].as_int(-1), static_cast<int>(fj[1].as_int(0))};
 
   MCMCStats mcmc;
+  // repeated-block pipeline metadata (pipe meshes are only legal on the
+  // ORIGINAL graph: a rewrite inside the body would break block identity)
+  PipelineMeta pipe = pipeline_meta_from_json(req.get("pipeline"));
   // "mesh shapes searched" means the original graph's candidate set; the
   // winning (possibly rewritten) graph may legalize a different set
-  int64_t mesh_candidates = (int64_t)enumerate_meshes(g0, m, cfg).size();
-  GraphEval best = eval_graph(g0, m, cfg, threshold, measured, false, nullptr);
+  int64_t mesh_candidates = (int64_t)enumerate_meshes(g0, m, cfg, pipe).size();
+  GraphEval best = eval_graph(g0, m, cfg, threshold, measured, false, nullptr,
+                              pipe);
   int64_t total_states = best.states;
   Graph best_g = g0;
   std::vector<RewriteTraceEntry> best_trace;
@@ -556,9 +665,11 @@ Json optimize(const Json& req) {
     }
   }
 
-  // MCMC refinement on the winning graph (FFModel::mcmc_optimize analog)
+  // MCMC refinement on the winning graph (FFModel::mcmc_optimize analog);
+  // pipe meshes stay in play only for the unrewritten graph
   if (cfg.budget > 0 && best.ok) {
-    GraphEval re = eval_graph(best_g, m, cfg, threshold, measured, true, &mcmc);
+    GraphEval re = eval_graph(best_g, m, cfg, threshold, measured, true, &mcmc,
+                              best_trace.empty() ? pipe : PipelineMeta{});
     total_states += re.states;
     if (re.ok && re.time <= best.time) best = re;
   }
@@ -574,7 +685,14 @@ Json optimize(const Json& req) {
   meshj.set("model", Json((int64_t)best.mesh.mp));
   meshj.set("seq", Json((int64_t)best.mesh.sp));
   meshj.set("expert", Json((int64_t)best.mesh.ep));
+  meshj.set("pipe", Json((int64_t)best.mesh.pp));
   out.set("mesh", meshj);
+  if (best.mesh.pp > 1) {
+    Json pj = Json::object();
+    pj.set("microbatches", Json((int64_t)best.pipe_microbatches));
+    pj.set("stages", Json((int64_t)best.mesh.pp));
+    out.set("pipeline", pj);
+  }
   Json ops = Json::object();
   for (size_t i = 0; i < g.nodes.size(); ++i) {
     const Choice& c = best.choices[i][best.assign[i]];
@@ -679,6 +797,10 @@ Json simulate_only(const Json& req) {
     tj.set("node", Json((int64_t)t.node_idx));
     tj.set("start", Json(t.start));
     tj.set("finish", Json(t.finish));
+    if (!t.collective.empty()) {
+      tj.set("collective", Json(t.collective));
+      tj.set("bytes", Json(t.bytes));
+    }
     tasks.push_back(tj);
   }
   out.set("tasks", tasks);

@@ -16,6 +16,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <map>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -31,6 +32,11 @@ struct SimTask {
   int node_idx = -1;  // -1 for Update
   double duration = 0;
   std::vector<int> deps;  // indices into task vector
+  // collective detail (Comm/GradSync): what the cost charges, so the
+  // priced set can be diffed against the collectives XLA actually emits
+  // (SURVEY §7 hard-part 3; tests/test_collective_validation.py)
+  std::string collective;  // "allreduce"|"allgather"|"ppermute"|"reshard"|""
+  double bytes = 0;        // global payload bytes priced
   // filled by the scheduler:
   double start = 0, finish = 0;
 };
@@ -62,6 +68,16 @@ class TaskgraphSimulator {
     };
 
     SimResult res;
+    // liveness accounting (inference): an activation frees at its last
+    // consumer; track the peak instead of the sum (reference
+    // bump-allocator role, simulator.h:699-700). Training keeps the sum:
+    // every activation is a saved-for-backward residual.
+    std::map<std::pair<int64_t, int>, size_t> last_use;
+    if (!training_)
+      for (size_t i = 0; i < N; ++i)
+        for (const EdgeRef& e : g_.nodes[i].inputs)
+          if (e.src_guid >= 0) last_use[{e.src_guid, e.src_idx}] = i;
+    double act_live = 0, act_peak = 0;
     // ---- forward + edge reshard tasks ----
     for (size_t i = 0; i < N; ++i) {
       const Node& n = g_.nodes[i];
@@ -80,38 +96,67 @@ class TaskgraphSimulator {
                                  (double)g_.nodes[pi].output_bytes(e.src_idx),
                                  mesh_, m_);
         if (rb > 0) {
-          SimTask ct{SimTask::Kind::Comm, (int)i, rb, {fwd_id[pi]}};
+          SimTask ct{SimTask::Kind::Comm, (int)i, rb, {fwd_id[pi]},
+                     "reshard",
+                     (double)g_.nodes[pi].output_bytes(e.src_idx)};
           deps.push_back(add(std::move(ct)));
           res.comm_time += rb;
         } else {
           deps.push_back(fwd_id[pi]);
         }
       }
-      SimTask ft{SimTask::Kind::Fwd, (int)i, nc.fwd, deps};
+      SimTask ft{SimTask::Kind::Fwd, (int)i, nc.fwd, deps, "", 0};
       fwd_id[i] = add(std::move(ft));
       res.fwd_time += nc.fwd;
       if (c.psum_bytes > 0 && c.psum_k > 1) {
         double t = m_.allreduce_time(c.psum_bytes, c.psum_k);
-        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
+                   "allreduce", c.psum_bytes};
         fwd_id[i] = add(std::move(ct));  // consumers wait on the psum
         res.comm_time += t;
       }
       if (c.ring_bytes > 0 && c.ring_k > 1) {
         // ring-attention K/V rotation (seq axis): runs on the ICI stream
         double t = m_.ring_time(c.ring_bytes, c.ring_k);
-        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
+                   "ppermute", c.ring_bytes};
         fwd_id[i] = add(std::move(ct));
         res.comm_time += t;
       }
       if (c.gather_bytes > 0 && c.gather_k > 1) {
         // all-gather a Combine boundary forces
         double t = m_.allgather_time(c.gather_bytes, c.gather_k);
-        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]}};
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
+                   "allgather", c.gather_bytes};
         fwd_id[i] = add(std::move(ct));
         res.comm_time += t;
       }
-      res.memory += node_memory(n, c, mesh_, opt_state_factor_);
+      res.memory += node_param_memory(n, c, mesh_, opt_state_factor_);
+      if (training_) {
+        res.memory += node_act_bytes(n, c, mesh_);
+      } else {
+        act_live += node_act_bytes(n, c, mesh_);
+        act_peak = std::max(act_peak, act_live);
+        // inputs whose last consumer is this node free now. A view op
+        // aliases its input, so consumption through a view conservatively
+        // never frees (overcounts slightly rather than undercounting).
+        for (const EdgeRef& e : is_view_op(n.type)
+                                    ? std::vector<EdgeRef>{} : n.inputs) {
+          if (e.src_guid < 0) continue;
+          auto lu = last_use.find({e.src_guid, e.src_idx});
+          if (lu != last_use.end() && lu->second == i) {
+            int pi = g_.index_of.at(e.src_guid);
+            const Choice& pc = assign[pi];
+            int k = e.src_idx < (int)pc.out.size()
+                        ? shards_of(pc.out[e.src_idx], mesh_) : 1;
+            act_live -=
+                (double)g_.nodes[pi].output_bytes(e.src_idx) / k;
+            last_use.erase(lu);  // free once even with multi-input reuse
+          }
+        }
+      }
     }
+    if (!training_) res.memory += act_peak;
 
     if (training_) {
       // ---- backward (reverse topo): bwd_i after bwd of all consumers ----
@@ -124,12 +169,16 @@ class TaskgraphSimulator {
         if (it != g_.consumers.end())
           for (const auto& cons : it->second)
             if (bwd_id[cons.first] >= 0) deps.push_back(bwd_id[cons.first]);
-        double dur = nc.bwd + (c.psum_k > 1 && c.psum_bytes > 0
-                                   ? m_.allreduce_time(c.psum_bytes, c.psum_k)
-                                   : 0.0);
+        double bwd_comm_bytes = 0;
+        double dur = nc.bwd;
+        if (c.psum_k > 1 && c.psum_bytes > 0) {
+          dur += m_.allreduce_time(c.psum_bytes, c.psum_k);
+          bwd_comm_bytes += c.psum_bytes;
+        }
         if (c.ring_bytes > 0 && c.ring_k > 1)  // bwd rotates K/V and dK/dV
           dur += 2.0 * m_.ring_time(c.ring_bytes, c.ring_k);
-        SimTask bt{SimTask::Kind::Bwd, i, dur, deps};
+        SimTask bt{SimTask::Kind::Bwd, i, dur, deps,
+                   bwd_comm_bytes > 0 ? "allreduce" : "", bwd_comm_bytes};
         bwd_id[i] = add(std::move(bt));
         res.bwd_time += dur;
       }
@@ -150,7 +199,8 @@ class TaskgraphSimulator {
                                             spans);
           std::vector<int> deps = {bwd_id[i]};
           if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
-          SimTask st{SimTask::Kind::GradSync, (int)i, t, deps};
+          SimTask st{SimTask::Kind::GradSync, (int)i, t, deps,
+                     "allreduce", c.gradsync_bytes};
           sync_ids.push_back(add(std::move(st)));
           res.gradsync_time += t;
         }
@@ -172,7 +222,7 @@ class TaskgraphSimulator {
                      (3.0 + 2.0 * opt_state_factor_);
       std::vector<int> deps = sync_ids;
       if (last_bwd >= 0) deps.push_back(last_bwd);
-      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / upd_bw, deps};
+      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / upd_bw, deps, "", 0};
       add(std::move(ut));
     }
 
@@ -210,5 +260,120 @@ class TaskgraphSimulator {
   double opt_state_factor_;
   const MeasuredCosts* measured_;
 };
+
+// ---- GPipe pipeline simulation (pp > 1 meshes) ----------------------------
+
+// Repeated-block metadata detected by the Python side
+// (flexflow_tpu/parallel/pipeline_detect.py) and shipped in the request.
+struct PipelineMeta {
+  bool present = false;
+  int num_blocks = 0;
+  std::set<int64_t> body, head, tail;
+  double block_out_bytes = 0;
+  int64_t batch = 0;
+};
+
+// Iteration time of the graph run as a pp-stage GPipe pipeline with M
+// microbatches, per-node inner choices `assign` (computed by the frontier
+// DP on the inner dp-only mesh). Model (parallel/pipeline.py semantics):
+//   * stages hold num_blocks/pp consecutive blocks; per-tick stage time is
+//     the body fwd (resp. bwd) cost / (pp * M), floored by per-op dispatch;
+//   * the schedule runs M + pp - 1 ticks forward and the same backward
+//     (bubble fraction (pp-1)/(M+pp-1));
+//   * each tick ppermutes the microbatch activation one hop (bwd: the
+//     returning gradient too);
+//   * head/tail ops run outside the pipeline on the full batch;
+//   * stage weights shard 1/pp: gradient sync, optimizer update and
+//     parameter memory divide by pp; activations kept for backward divide
+//     by pp as well, but the microbatch queue + output buffer replicate
+//     over the pipe axis (the current lowering's documented memory
+//     caveat), charged as 2x the body boundary tensor.
+inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
+                                   const MeshShape& mesh,
+                                   const std::vector<Choice>& assign,
+                                   const PipelineMeta& meta, bool training,
+                                   double opt_state_factor,
+                                   const MeasuredCosts* measured, int M) {
+  SimResult res;
+  const int pp = mesh.pp;
+  double fwd_body = 0, bwd_body = 0, fwd_edge = 0;
+  double body_params = 0, body_act = 0, body_gradsync_bytes = 0;
+  int body_ops = 0;
+  int gradsync_k = mesh.dp;
+  double head_tail_time = 0, head_tail_params = 0, head_tail_act = 0,
+         head_tail_gradsync = 0;
+  MeshShape inner = mesh;
+  inner.pp = 1;
+  for (size_t i = 0; i < g.nodes.size(); ++i) {
+    const Node& n = g.nodes[i];
+    const Choice& c = assign[i];
+    NodeCost nc = node_cost(n, c, inner, m, training, measured);
+    double params = detail::sharded_param_bytes(n, c, inner);
+    double act = 0;
+    for (size_t oi = 0; oi < n.output_shapes.size(); ++oi)
+      act += (double)n.output_bytes(oi) /
+             (oi < c.out.size() ? shards_of(c.out[oi], inner) : 1);
+    if (meta.body.count(n.guid)) {
+      fwd_body += nc.fwd;
+      bwd_body += nc.bwd;
+      fwd_edge += nc.comm;
+      body_params += params;
+      body_act += act;
+      if (c.gradsync_bytes > 0 && c.gradsync_k > 1)
+        body_gradsync_bytes += c.gradsync_bytes;
+      if (!is_view_op(n.type)) ++body_ops;
+    } else {
+      head_tail_time += nc.fwd + nc.bwd + nc.comm;
+      head_tail_params += params;
+      head_tail_act += act;
+      if (c.gradsync_bytes > 0 && c.gradsync_k > 1)
+        head_tail_gradsync +=
+            m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
+                                  slices_spanned(inner, m));
+    }
+  }
+  const double ticks = M + pp - 1;
+  // per-tick stage compute, floored by the per-op dispatch minimum of the
+  // ops one stage executes per microbatch
+  double op_floor = (double)body_ops / pp * m.min_op_time;
+  double tick_fwd = std::max(fwd_body / (pp * M), op_floor);
+  double tick_bwd = std::max(bwd_body / (pp * M), op_floor);
+  // activation hop: boundary tensor / (M * dp) per microbatch shard
+  double hop_bytes = meta.block_out_bytes * m.comm_bytes_factor /
+                     ((double)M * mesh.dp);
+  double hop = m.ici_latency + hop_bytes / m.ici_bw;
+  res.fwd_time = ticks * (tick_fwd + hop) + fwd_edge;
+  res.comm_time = ticks * hop * (training ? 2.0 : 1.0) + fwd_edge;
+  // fwd_edge (per-op collectives of body choices) charges iteration_time
+  // too — pp>1 meshes must not be costed comm-free vs the taskgraph sim
+  res.iteration_time = head_tail_time + ticks * (tick_fwd + hop) + fwd_edge;
+  if (training) {
+    res.bwd_time = ticks * (tick_bwd + hop);
+    res.iteration_time += res.bwd_time;
+    if (mesh.dp > 1 && body_gradsync_bytes > 0)
+      res.gradsync_time = m.hier_allreduce_time(body_gradsync_bytes / pp,
+                                                gradsync_k,
+                                                slices_spanned(inner, m));
+    res.gradsync_time += head_tail_gradsync;
+    res.iteration_time += res.gradsync_time;
+    double upd_bw = m.hbm_bw;
+    if (measured != nullptr) {
+      auto it = measured->find("__update_bw__");
+      if (it != measured->end() && it->second > 0) upd_bw = it->second;
+    }
+    double upd_bytes = (body_params / pp + head_tail_params) *
+                       (3.0 + 2.0 * opt_state_factor);
+    res.iteration_time += upd_bytes / upd_bw;
+  }
+  if (measured != nullptr) {
+    auto it = measured->find("__step_overhead__");
+    if (it != measured->end()) res.iteration_time += it->second;
+  }
+  res.memory = (body_params / pp + head_tail_params) *
+                   (1.0 + (training ? opt_state_factor : 0.0)) +
+               (training ? body_act / pp + head_tail_act : 0.0) +
+               2.0 * meta.block_out_bytes / mesh.dp;  // queue + out buffer
+  return res;
+}
 
 }  // namespace ffsearch

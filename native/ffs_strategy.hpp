@@ -52,6 +52,11 @@ struct MeshShape {
   int mp = 1;  // model (tensor/attribute) axis
   int sp = 1;  // seq (context/ring) axis
   int ep = 1;  // expert axis
+  int pp = 1;  // pipe axis (GPipe stages; r4 — the reference only stubs
+               // OP_PIPELINE, ffconst.h:153). pp > 1 requires a
+               // repeated-block graph; per-node choices then apply to the
+               // inner (dp) mesh and the pipeline wraps them (ffs_sim.hpp
+               // simulate_pipeline).
   int axis_size(int8_t axis) const {
     switch (axis) {
       case kData: return dp;
@@ -62,7 +67,7 @@ struct MeshShape {
       default: return 1;
     }
   }
-  int total() const { return dp * mp * sp * ep; }
+  int total() const { return dp * mp * sp * ep * pp; }
 };
 
 inline Spec rep_spec(size_t rank) { return Spec(rank, kRep); }
@@ -125,6 +130,7 @@ inline double reshard_cost(const Spec& a, const Spec& b, double global_bytes,
   for (size_t i = 0; i < b.size(); ++i) if (b[i] >= 0) expand(sb, (int)i, b[i]);
   bool a_in_b = std::includes(sb.begin(), sb.end(), sa.begin(), sa.end());
   if (a_in_b) return 0.0;  // pure additional slicing: local
+  global_bytes *= m.comm_bytes_factor;  // bf16 activations on TPU
   bool b_in_a = std::includes(sa.begin(), sa.end(), sb.begin(), sb.end());
   int k_keep = 1;
   for (const auto& p : sa)
@@ -577,17 +583,36 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   return nc;
 }
 
-// Per-device memory of a node under a choice: sharded params (+optimizer
-// state) + sharded activations (kept for backward).
-inline double node_memory(const Node& n, const Choice& c, const MeshShape& mesh,
-                          double opt_state_factor) {
+// Per-device parameter (+optimizer-state) bytes of a node under a choice —
+// permanent for the whole iteration.
+inline double node_param_memory(const Node& n, const Choice& c,
+                                const MeshShape& mesh,
+                                double opt_state_factor) {
+  if (is_view_op(n.type)) return 0.0;
+  return detail::sharded_param_bytes(n, c, mesh) * (1.0 + opt_state_factor);
+}
+
+// Per-device activation bytes a node's outputs occupy while live.
+inline double node_act_bytes(const Node& n, const Choice& c,
+                             const MeshShape& mesh) {
   if (is_view_op(n.type)) return 0.0;  // fused away: materializes nothing
-  double mem = detail::sharded_param_bytes(n, c, mesh) * (1.0 + opt_state_factor);
+  double mem = 0;
   for (size_t i = 0; i < n.output_shapes.size(); ++i) {
     int k = i < c.out.size() ? shards_of(c.out[i], mesh) : 1;
     mem += (double)n.output_bytes(i) / k;
   }
   return mem;
+}
+
+// Per-device memory of a node under a choice: sharded params (+optimizer
+// state) + sharded activations. Under training every activation is a
+// saved-for-backward residual, so the whole-graph sum IS the backward-start
+// peak; inference uses the liveness-aware accounting in the DP/simulator
+// instead (reference bump-allocator role, simulator.h:699-700).
+inline double node_memory(const Node& n, const Choice& c, const MeshShape& mesh,
+                          double opt_state_factor) {
+  return node_param_memory(n, c, mesh, opt_state_factor) +
+         node_act_bytes(n, c, mesh);
 }
 
 }  // namespace ffsearch
