@@ -56,7 +56,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, causal: bool, interpret: bool):
+def _flash_fwd(q, k, v, causal: bool, interpret: bool, out_dtype=None):
     """q,k,v: [BH, S, D] with S % BLK_Q == 0 -> (o, lse[BH, S])."""
     bh, s, d = q.shape
     scale = 1.0 / float(d) ** 0.5
@@ -66,7 +66,7 @@ def _flash_fwd(q, k, v, causal: bool, interpret: bool):
         # lse is (bh, 1, s): TPU requires the last two block dims be
         # (8,128)-aligned or span the array — a middle singleton satisfies
         # that while keeping one row per (batch*head)
-        out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), out_dtype or q.dtype),
                    jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)),
         grid=(bh, s // BLK_Q),
         in_specs=[
@@ -83,21 +83,30 @@ def _flash_fwd(q, k, v, causal: bool, interpret: bool):
 # Longest sequence whose full S x S f32 score tile (plus q/k/v/do/dq/dk/dv
 # panels) fits one core's VMEM in the single-block backward kernel.
 MAX_BWD_SEQ = 1024
+# Longest sequence the K-blocked backward kernel handles: VMEM holds the
+# full Q/dO/dQ panels (S x D) plus S x BLK_Q score tiles — ~2.2 KB per row
+# at D=64, so 16k rows ~= 35 MB, comfortably inside a v5e core's VMEM.
+MAX_BWD_BLOCKED_SEQ = 16384
 
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, causal: bool, scale: float):
+                      glse_ref, dq_ref, dk_ref, dv_ref, *, causal: bool,
+                      scale: float):
     """FlashAttention-2 backward, one (batch*head) per grid cell with the
     whole sequence in VMEM (gated by MAX_BWD_SEQ): recompute P from Q,K and
-    the saved LSE, then dV = P^T dO; dS = P * (dO V^T - delta);
+    the saved LSE, then dV = P^T dO; dS = P * (dO V^T - delta + g_lse);
     dQ = dS K * scale; dK = dS^T Q * scale. Scores/probabilities never
-    touch HBM — the reason XLA's einsum backward loses at these shapes."""
+    touch HBM — the reason XLA's einsum backward loses at these shapes.
+    ``g_lse`` is the upstream gradient on the logsumexp output (zero when
+    only o is consumed; nonzero under ring attention's streaming merge,
+    where the merge weights are functions of each block's lse)."""
     q = q_ref[0].astype(jnp.float32)   # [S, D]
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]                 # [S]
     delta = delta_ref[0, 0]             # [S] rowsum(dO * O)
+    glse = glse_ref[0, 0]               # [S] upstream d/d lse
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
@@ -107,7 +116,7 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p = jnp.exp(s - lse[:, None])       # exact softmax probs
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta[:, None] + glse[:, None])
     dq_ref[0] = (jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
                  * scale).astype(dq_ref.dtype)
@@ -119,11 +128,14 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                     ).astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool):
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool,
+               glse=None):
     bh, s, d = q.shape
     scale = 1.0 / float(d) ** 0.5
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    if glse is None:
+        glse = jnp.zeros((bh, 1, s), jnp.float32)
     kern = functools.partial(_flash_bwd_kernel, causal=causal, scale=scale)
     seq_spec = pl.BlockSpec((1, s, d), lambda b: (b, 0, 0))
     row_spec = pl.BlockSpec((1, 1, s), lambda b: (b, 0, 0))
@@ -134,14 +146,94 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool):
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
         grid=(bh,),
         in_specs=[seq_spec, seq_spec, seq_spec, seq_spec, row_spec,
-                  row_spec],
+                  row_spec, row_spec],
         out_specs=(seq_spec, seq_spec, seq_spec),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, glse)
+
+
+def _flash_bwd_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, glse_ref, dq_ref, dk_ref, dv_ref,
+                              *, causal: bool, scale: float, blk: int):
+    """FA2 backward for sequences past MAX_BWD_SEQ: grid cell = one
+    (batch*head, K-block). The full Q/dO panels are resident; the
+    [S, BLK] score tile for this K-block is recomputed in VMEM; dK/dV
+    write their block, and dQ accumulates in-place across the K-block
+    grid dimension (same output block revisited -> Pallas keeps it in
+    VMEM between consecutive steps)."""
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)    # [S, D]
+    k = k_ref[0].astype(jnp.float32)    # [BLK, D]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # [S, D]
+    lse = lse_ref[0, 0]                 # [S]
+    delta = delta_ref[0, 0]
+    glse = glse_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= rows, s, -jnp.inf)
+    p = jnp.exp(s - lse[:, None])       # [S, BLK]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None] + glse[:, None])
+    dk_ref[0] = (jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+                 * scale).astype(dk_ref.dtype)
+    dv_ref[0] = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32
+                                    ).astype(dv_ref.dtype)
+    dq_blk = (jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+              * scale)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = dq_blk
+
+    @pl.when(j > 0)
+    def _acc():
+        dq_ref[0] += dq_blk
+
+
+def _flash_bwd_blocked(q, k, v, o, lse, do, causal: bool, interpret: bool,
+                       glse=None):
+    bh, s, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    if glse is None:
+        glse = jnp.zeros((bh, 1, s), jnp.float32)
+    blk = BLK_Q
+    kern = functools.partial(_flash_bwd_blocked_kernel, causal=causal,
+                             scale=scale, blk=blk)
+    seq_spec = pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0))
+    kblk_spec = pl.BlockSpec((1, blk, d), lambda b, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, 1, s), lambda b, j: (b, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),  # dq acc
+                   jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, s // blk),
+        in_specs=[seq_spec, kblk_spec, kblk_spec, seq_spec, row_spec,
+                  row_spec, row_spec],
+        out_specs=(seq_spec, kblk_spec, kblk_spec),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, glse)
+    return dq.astype(q.dtype), dk, dv
 
 
 def _xla_attention(q, k, v, causal: bool):
     """Reference einsum path (used for backward recompute + fallback)."""
+    return _xla_attention_lse(q, k, v, causal)[0]
+
+
+def _xla_attention_lse(q, k, v, causal: bool):
+    """Einsum path that also emits the per-row logsumexp (long-seq
+    backward fallback for flash_attention_lse)."""
     d = q.shape[-1]
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) / jnp.sqrt(jnp.float32(d))
@@ -149,8 +241,10 @@ def _xla_attention(q, k, v, causal: bool):
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return o, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -167,15 +261,52 @@ def _flash_vjp_bwd(causal, interpret, res, g):
     q, k, v, o, lse = res
     if q.shape[1] <= MAX_BWD_SEQ:
         return _flash_bwd(q, k, v, o, lse, g, causal, interpret)
-    # long sequences: the S x S tile no longer fits VMEM — recompute via
-    # the XLA einsum path (remat; the score tensor wouldn't fit HBM-wise
-    # in the fwd residuals either)
+    if q.shape[1] <= MAX_BWD_BLOCKED_SEQ:
+        # K-blocked kernel: scores stay in VMEM tiles at any length the
+        # Q/dO/dQ panels fit
+        return _flash_bwd_blocked(q, k, v, o, lse, g, causal, interpret)
+    # extreme lengths: XLA einsum recompute (materializes S x S in HBM)
     _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal),
                      q, k, v)
     return vjp(g)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_lse(q, k, v, causal, interpret):
+    """Flash attention returning (o, lse[BH, S]) — the streaming-merge
+    primitive ring attention accumulates per K/V block. Differentiable:
+    the backward kernel carries the upstream lse gradient (the merge
+    weights are functions of lse). q,k,v: [BH, S, D]. ``o`` is emitted in
+    f32: the ring merge accumulates in f32, and rounding each block's
+    normalized output to bf16 first would compound per-block error."""
+    o, lse = _flash_fwd(q, k, v, causal, interpret, out_dtype=jnp.float32)
+    return o, lse[:, 0, :]
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, interpret, out_dtype=jnp.float32)
+    return (o, lse[:, 0, :]), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(causal, interpret, res, gs):
+    q, k, v, o, lse = res
+    g_o, g_lse = gs
+    glse = g_lse[:, None, :].astype(jnp.float32)
+    if q.shape[1] <= MAX_BWD_SEQ:
+        return _flash_bwd(q, k, v, o, lse, g_o, causal, interpret,
+                          glse=glse)
+    if q.shape[1] <= MAX_BWD_BLOCKED_SEQ:
+        return _flash_bwd_blocked(q, k, v, o, lse, g_o, causal, interpret,
+                                  glse=glse)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention_lse(q_, k_, v_, causal), q, k, v)
+    return vjp((g_o, g_lse))
+
+
+flash_attention_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def pallas_mode() -> str:
