@@ -140,9 +140,18 @@ class OperatorType(enum.Enum):
     DROPOUT = enum.auto()
     GATHER = enum.auto()
     REDUCE_SUM = enum.auto()
+    REDUCE_MAX = enum.auto()
     MEAN = enum.auto()
     TOPK = enum.auto()
     ARG_TOPK = enum.auto()
+    # r4 additions for torch.fx frontend depth (reference table
+    # python/flexflow/torch/model.py:2408-2496 covers these kinds)
+    CONST = enum.auto()      # embedded constant (fx get_attr buffers)
+    WHERE = enum.auto()      # select(cond, a, b) — masked_fill/where
+    EXPAND = enum.auto()     # broadcast_to (torch expand/repeat)
+    EINSUM = enum.auto()     # general einsum contraction
+    GROUPNORM = enum.auto()  # nn.GroupNorm
+    LOG = enum.auto()        # elementwise natural log
     # MoE quartet (+ gating sugar)
     GROUP_BY = enum.auto()
     AGGREGATE = enum.auto()

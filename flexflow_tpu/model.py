@@ -154,6 +154,14 @@ class FFModel:
             axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps), name)
         return self._finish(layer)
 
+    def group_norm(self, input: Tensor, groups: int, eps: float = 1e-5,
+                   affine: bool = True, name: Optional[str] = None) -> Tensor:
+        """nn.GroupNorm analog (r4): per-group channel normalization."""
+        layer = self._add_layer(OperatorType.GROUPNORM, [input],
+                                dict(groups=groups, eps=eps, affine=affine),
+                                name)
+        return self._finish(layer)
+
     def rms_norm(self, input: Tensor, eps: float = 1e-6,
                  name: Optional[str] = None) -> Tensor:
         """RMSNorm over the last dim (Llama/T5 family; new scope vs the
@@ -281,6 +289,35 @@ class FFModel:
     def reduce_sum(self, input: Tensor, axes, keepdims: bool = False, name=None) -> Tensor:
         layer = self._add_layer(OperatorType.REDUCE_SUM, [input],
                                 dict(axes=tuple(axes), keepdims=keepdims), name)
+        return self._finish(layer)
+
+    def reduce_max(self, input: Tensor, axes, keepdims: bool = False, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.REDUCE_MAX, [input],
+                                dict(axes=tuple(axes), keepdims=keepdims), name)
+        return self._finish(layer)
+
+    def log(self, x, name=None):
+        return self._unary(OperatorType.LOG, x, name)
+
+    def constant(self, value, name=None) -> Tensor:
+        """Embedded constant tensor (fx get_attr buffers, masks, tables)."""
+        import numpy as _np
+        layer = self._add_layer(OperatorType.CONST, [],
+                                dict(value=_np.asarray(value)), name)
+        return self._finish(layer)
+
+    def where(self, cond: Tensor, a: Tensor, b: Tensor, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.WHERE, [cond, a, b], {}, name)
+        return self._finish(layer)
+
+    def expand(self, input: Tensor, shape, name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.EXPAND, [input],
+                                dict(shape=tuple(shape)), name)
+        return self._finish(layer)
+
+    def einsum(self, equation: str, tensors: Sequence[Tensor], name=None) -> Tensor:
+        layer = self._add_layer(OperatorType.EINSUM, list(tensors),
+                                dict(equation=equation), name)
         return self._finish(layer)
 
     def mean(self, input: Tensor, dims, keepdims: bool = False, name=None) -> Tensor:

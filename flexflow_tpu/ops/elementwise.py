@@ -24,6 +24,7 @@ _UNARY_FNS = {
     OperatorType.TANH: jnp.tanh,
     OperatorType.ELU: jax.nn.elu,
     OperatorType.RSQRT: jax.lax.rsqrt,
+    OperatorType.LOG: jnp.log,
     OperatorType.IDENTITY: lambda x: x,
 }
 

@@ -62,6 +62,51 @@ class LayerNorm(Op):
         return 2 * int(np.prod(self._norm_shape())) if self.elementwise_affine else 0
 
 
+@register_op(OperatorType.GROUPNORM)
+class GroupNorm(Op):
+    """nn.GroupNorm for NCHW/NC inputs: normalize each of ``groups``
+    channel groups over (C/G, *spatial), per-channel affine (r4 torch.fx
+    frontend parity; reference table python/flexflow/torch/model.py)."""
+
+    def __init__(self, layer, input_shapes):
+        self.groups = layer.get_property("groups", 1)
+        self.eps = layer.get_property("eps", 1e-5)
+        self.affine = layer.get_property("affine", True)
+        c = input_shapes[0][1]
+        if c % self.groups:
+            raise ValueError(
+                f"group_norm: {c} channels not divisible by "
+                f"{self.groups} groups")
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.input_shapes[0]]
+
+    def init_params(self, rng):
+        if not self.affine:
+            return {}
+        c = self.input_shapes[0][1]
+        return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        n, c = x.shape[0], x.shape[1]
+        g = self.groups
+        xf = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
+        axes = tuple(range(2, xf.ndim))
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=axes, keepdims=True)
+        y = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).reshape(x.shape)
+        if self.affine:
+            shape = (1, c) + (1,) * (x.ndim - 2)
+            y = y * params["scale"].reshape(shape) \
+                + params["bias"].reshape(shape)
+        return [y.astype(x.dtype)]
+
+    def params_elems(self):
+        return 2 * int(self.input_shapes[0][1]) if self.affine else 0
+
+
 @register_op(OperatorType.RMSNORM)
 class RMSNorm(Op):
     """Root-mean-square normalization over the last dim (Llama/T5 family;

@@ -120,6 +120,128 @@ class Cast(Op):
         return [inputs[0].astype(self.target_dtype.jnp_dtype)]
 
 
+@register_op(OperatorType.CONST)
+class Const(Op):
+    """Embedded constant tensor (torch.fx get_attr buffers — e.g. a GPT-2
+    causal mask registered as a module buffer). Not trainable; the value
+    is baked into the traced program."""
+
+    def __init__(self, layer, input_shapes):
+        self.value = np.asarray(layer.get_property("value"))
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [tuple(self.value.shape)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.asarray(self.value)]
+
+    def output_dim_roles(self):
+        return [tuple(DimRole.OTHER for _ in self.value.shape)]
+
+
+@register_op(OperatorType.WHERE)
+class Where(Op):
+    """select(cond, a, b) — torch.where / masked_fill. cond may be bool
+    or a 0/1 float mask; broadcasting follows numpy rules."""
+
+    def compute_output_shapes(self):
+        return [tuple(np.broadcast_shapes(*self.input_shapes))]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        cond, a, b = inputs
+        return [jnp.where(cond.astype(bool), a, b)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.EXPAND)
+class Expand(Op):
+    """broadcast_to (torch expand / repeat with unit source dims)."""
+
+    def __init__(self, layer, input_shapes):
+        self.target = tuple(layer.get_property("shape"))
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        return [self.target]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.broadcast_to(inputs[0], self.target)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.EINSUM)
+class Einsum(Op):
+    """General einsum contraction (torch.einsum). The MXU path for any
+    equation XLA can lower to dots."""
+
+    def __init__(self, layer, input_shapes):
+        self.equation = layer.get_property("equation")
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        lhs, _, out = self.equation.replace(" ", "").partition("->")
+        terms = lhs.split(",")
+        sizes = {}
+        for term, shp in zip(terms, self.input_shapes):
+            for ch, d in zip(term, shp):
+                sizes[ch] = d
+        if not out and "->" not in self.equation:
+            # implicit output: sorted letters appearing exactly once
+            from collections import Counter
+            c = Counter("".join(terms))
+            out = "".join(sorted(ch for ch, k in c.items() if k == 1))
+        return [tuple(sizes[ch] for ch in out)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        cd = ctx.compute_dtype
+        return [jnp.einsum(self.equation, *[x.astype(cd) for x in inputs],
+                           preferred_element_type=jnp.float32
+                           ).astype(inputs[0].dtype)]
+
+    def flops(self):
+        lhs, _, _ = self.equation.replace(" ", "").partition("->")
+        sizes = {}
+        for term, shp in zip(lhs.split(","), self.input_shapes):
+            for ch, d in zip(term, shp):
+                sizes[ch] = d
+        total = 1
+        for d in sizes.values():
+            total *= d
+        return 2 * total
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
+@register_op(OperatorType.REDUCE_MAX)
+class ReduceMax(Op):
+    def __init__(self, layer, input_shapes):
+        self.axes = tuple(layer.get_property("axes"))
+        self.keepdims = layer.get_property("keepdims", False)
+        super().__init__(layer, input_shapes)
+
+    def compute_output_shapes(self):
+        s = list(self.input_shapes[0])
+        axes = sorted(a % len(s) for a in self.axes)
+        for a in reversed(axes):
+            if self.keepdims:
+                s[a] = 1
+            else:
+                s.pop(a)
+        return [tuple(s)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [jnp.max(inputs[0], axis=self.axes, keepdims=self.keepdims)]
+
+    def output_dim_roles(self):
+        return [_default_roles(self.output_shapes[0])]
+
+
 @register_op(OperatorType.GATHER)
 class Gather(Op):
     """take_along_axis gather (src/ops/gather.cc): out[idx] along dim."""

@@ -54,6 +54,24 @@ def _props_from_attrs(op_type: OperatorType, attrs) -> dict:
         p["degree"] = int(a.get("degree", 1))
     elif op_type == OperatorType.REPLICATE:
         p["degree"] = int(a.get("degree", 1))
+    elif op_type == OperatorType.FUSED_PARALLEL:
+        # step chain [[type, dim, degree], ...] -> (type, dim, degree,
+        # axis) tuples; axis assignment mirrors FFModel.repartition
+        p["ops"] = [
+            (str(k), int(d), int(g), "data" if int(d) == 0 else "model")
+            for (k, d, g) in a["ops"]
+        ]
+    elif op_type == OperatorType.CONV2D:
+        p["out_channels"] = int(a["out_channels"])
+        p["kernel_h"] = int(a.get("kernel_h", 1))
+        p["kernel_w"] = int(a.get("kernel_w", 1))
+        p["stride_h"] = int(a.get("stride_h", 1))
+        p["stride_w"] = int(a.get("stride_w", 1))
+        p["padding_h"] = int(a.get("padding_h", 0))
+        p["padding_w"] = int(a.get("padding_w", 0))
+        p["groups"] = int(a.get("groups", 1))
+        p["activation"] = ActiMode(int(a.get("activation", 0)))
+        p["use_bias"] = bool(a.get("use_bias", 1))
     else:
         # unary / elementwise / identity need nothing; pass through extras
         for k, v in a.items():

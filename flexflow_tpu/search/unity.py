@@ -33,10 +33,28 @@ def _param_shapes(op) -> Dict[str, List[int]]:
 def _node_attrs(op) -> Dict[str, Any]:
     attrs = {}
     for k in ("num_heads", "groups", "axis", "out_dim", "k", "n",
-              "n_experts", "hidden_size", "alpha"):
+              "n_experts", "hidden_size", "alpha", "out_channels"):
         v = getattr(op, k, None)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             attrs[k] = v
+    # conv/pool geometry (stored as (h, w) tuples on the op): needed so a
+    # rewrite that re-emits the op (Conv+BN fold) replays into a real
+    # Conv2D
+    for name, keys in (("kernel", ("kernel_h", "kernel_w")),
+                       ("stride", ("stride_h", "stride_w")),
+                       ("padding", ("padding_h", "padding_w"))):
+        v = getattr(op, name, None)
+        if isinstance(v, tuple) and len(v) == 2:
+            attrs[keys[0]], attrs[keys[1]] = int(v[0]), int(v[1])
+    # BatchNorm's fused relu flag (PM_RELU in the substitution engine)
+    relu = getattr(op, "relu", None)
+    if isinstance(relu, bool):
+        attrs["relu"] = int(relu)
+    # FusedParallelOp step chain
+    fused = getattr(op, "fused_ops", None)
+    if fused:
+        attrs["ops"] = [[k.name if hasattr(k, "name") else str(k),
+                         int(d), int(g)] for (k, d, g, _a) in fused]
     # the substitution engine matches on these (PM_* keys, ffs_subst.hpp)
     act = getattr(op, "activation", None)
     if act is not None and hasattr(act, "value"):

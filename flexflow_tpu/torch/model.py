@@ -137,8 +137,17 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
             cfg = dict(dim=mod.dim)
         elif isinstance(mod, nn.Flatten):
             cfg = dict(start_dim=mod.start_dim)
+        elif isinstance(mod, nn.GroupNorm):
+            cfg = dict(num_groups=mod.num_groups, eps=mod.eps,
+                       affine=mod.affine)
+        elif isinstance(mod, nn.LeakyReLU):
+            cfg = dict(negative_slope=mod.negative_slope)
+        elif isinstance(mod, nn.AdaptiveAvgPool2d):
+            cfg = dict(output_size=_pair(mod.output_size))
+        elif hasattr(nn, "RMSNorm") and isinstance(mod, nn.RMSNorm):
+            cfg = dict(eps=mod.eps if mod.eps is not None else 1e-6)
         elif isinstance(mod, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh,
-                              nn.Identity)):
+                              nn.SiLU, nn.ELU, nn.Identity)):
             cfg = {}
         else:
             raise NotImplementedError(
@@ -147,6 +156,20 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
     elif node.op in ("call_function", "call_method"):
         t = node.target
         d["target"] = t if isinstance(t, str) else getattr(t, "__name__", str(t))
+    elif node.op == "get_attr":
+        # module buffer/parameter referenced directly (e.g. a registered
+        # causal-mask buffer): embed its value as a constant. Reduced
+        # dtypes (bf16/f16/bool) have no numpy/JSON path — store as f32.
+        obj = module
+        for part in str(node.target).split("."):
+            obj = getattr(obj, part)
+        t = obj.detach().cpu()
+        if t.dtype in (torch.bfloat16, torch.float16, torch.bool):
+            t = t.float()
+        arr = t.numpy()
+        d["target"] = "get_attr"
+        d["value"] = arr.tolist()
+        d["value_dtype"] = str(arr.dtype)
     elif node.op == "placeholder":
         d["target"] = node.name
         d["shape"] = list(shapes.get(node.name, ()))
@@ -170,6 +193,42 @@ def torch_to_ff_file(module, path: str, input_shapes: Dict[str, Sequence[int]],
     descs = trace_module(module, input_shapes, batch_size)
     with open(path, "w") as f:
         json.dump({"version": 1, "nodes": descs}, f, indent=1)
+
+
+# ---- HF causal-LM state-dict path -----------------------------------------
+
+def from_hf_causal_lm(hf_model, batch_size: int, seq_length: int,
+                      ff_config=None):
+    """State-dict-driven frontend path for HuggingFace causal LMs.
+
+    The reference's HF-aware fx tracing (python/flexflow/torch/
+    model.py:2424-2444) routes HF modules through symbolic_trace, which
+    the environment's py3.12 breaks; recognized families instead build
+    the native zoo model from the module's config and import the state
+    dict. Returns ``(ff, load_weights)`` — call ``load_weights()`` AFTER
+    ``ff.compile(...)``; it returns the number of tensors copied.
+    """
+    name = type(hf_model).__name__
+    if "Llama" in name:
+        from flexflow_tpu.models.llama import (LlamaModelConfig,
+                                               create_llama,
+                                               import_hf_weights)
+        c = hf_model.config
+        cfg = LlamaModelConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_hidden_layers=c.num_hidden_layers,
+            num_attention_heads=c.num_attention_heads,
+            num_key_value_heads=getattr(c, "num_key_value_heads",
+                                        c.num_attention_heads),
+            rms_norm_eps=c.rms_norm_eps,
+            rope_theta=getattr(c, "rope_theta", 10000.0),
+            batch_size=batch_size, seq_length=seq_length)
+        ff = create_llama(cfg, ff_config)
+        return ff, (lambda: import_hf_weights(ff, hf_model))
+    raise NotImplementedError(
+        f"no state-dict translation for {name}; use PyTorchModel (fx "
+        f"tracing) for plain torch modules")
 
 
 # ---- translation to FFModel ----------------------------------------------
@@ -232,6 +291,13 @@ class PyTorchModel:
         op, target = d["op"], d.get("target")
         cfg = d.get("config", {})
         name = d["name"]
+        if op == "get_attr":
+            value = np.asarray(d["value"],
+                               dtype=np.dtype(d.get("value_dtype",
+                                                    "float32"))
+                               if d.get("value_dtype") != "bool"
+                               else np.float32)
+            return ff.constant(value, name=name)
         if op == "call_module":
             if target == "Linear":
                 return ff.dense(args[0], cfg["out_features"],
@@ -303,6 +369,28 @@ class PyTorchModel:
                 return ff.sigmoid(args[0], name=name)
             if target == "Tanh":
                 return ff.tanh(args[0], name=name)
+            if target == "SiLU":
+                sig = ff.sigmoid(args[0], name=f"{name}_sig")
+                return ff.multiply(args[0], sig, name=name)
+            if target == "ELU":
+                return ff.elu(args[0], name=name)
+            if target == "LeakyReLU":
+                return self._emit_function(
+                    ff, "leaky_relu", [args[0],
+                                       cfg.get("negative_slope", 0.01)],
+                    {}, name)
+            if target == "GroupNorm":
+                return ff.group_norm(args[0], cfg["num_groups"],
+                                     eps=cfg.get("eps", 1e-5),
+                                     affine=cfg.get("affine", True),
+                                     name=name)
+            if target == "RMSNorm":
+                return ff.rms_norm(args[0], eps=cfg.get("eps", 1e-6),
+                                   name=name)
+            if target == "AdaptiveAvgPool2d":
+                return self._emit_function(
+                    ff, "adaptive_avg_pool2d",
+                    [args[0], cfg["output_size"]], {}, name)
             if target == "Identity":
                 return ff.identity(args[0], name=name)
         elif op in ("call_function", "call_method"):
@@ -566,6 +654,130 @@ class PyTorchModel:
         if target == "size":
             raise NotImplementedError(
                 "dynamic .size() in traced graph — use static shapes")
+        if target == "einsum":
+            eq = args[0]
+            ts = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
+            return ff.einsum(eq, list(ts), name=name)
+        if target in ("expand", "expand_as", "broadcast_to"):
+            if target == "expand_as":
+                shape = list(args[1].shape)
+            else:
+                shape = list(args[1] if isinstance(args[1], (list, tuple))
+                             else args[1:])
+            cur = list(args[0].shape)
+            # torch expand: -1 keeps the source extent (align ranks first)
+            cur_al = [1] * (len(shape) - len(cur)) + cur
+            shape = [cur_al[i] if s == -1 else s
+                     for i, s in enumerate(shape)]
+            return ff.expand(args[0], shape, name=name)
+        if target in ("masked_fill", "masked_fill_"):
+            # fill via a broadcast constant, NOT x*0+value (x may hold inf
+            # from a previous mask, and inf*0 = NaN)
+            x, mask, value = args[0], args[1], float(args[2])
+            fill = ff.constant(np.full(tuple(x.shape), value, np.float32),
+                               name=f"{name}_fill")
+            return ff.where(mask, fill, x, name=name)
+        if target == "where":
+            return ff.where(args[0], args[1], args[2], name=name)
+        if target in ("clamp", "clamp_", "clip"):
+            x = args[0]
+            lo = kwargs.get("min", args[1] if len(args) > 1 else None)
+            hi = kwargs.get("max", args[2] if len(args) > 2 else None)
+            if lo is not None:  # max(x, lo) = relu(x - lo) + lo
+                x = ff.scalar_add(
+                    ff.relu(ff.scalar_sub(x, float(lo),
+                                          name=f"{name}_s1")),
+                    float(lo), name=f"{name}_lo")
+            if hi is not None:  # min(x, hi) = hi - relu(hi - x)
+                neg = ff.scalar_multiply(x, -1.0, name=f"{name}_n")
+                x = ff.scalar_multiply(
+                    ff.scalar_add(
+                        ff.relu(ff.scalar_add(neg, float(hi),
+                                              name=f"{name}_s2")),
+                        -float(hi), name=f"{name}_hi2"),
+                    -1.0, name=f"{name}_hi")
+            return x
+        if target == "clamp_min":
+            return self._emit_function(ff, "clamp", [args[0], args[1]],
+                                       {}, name)
+        if target == "abs":
+            neg = ff.scalar_multiply(args[0], -1.0, name=f"{name}_neg")
+            return ff.max(args[0], neg, name=name)
+        if target == "log":
+            return ff.log(args[0], name=name)
+        if target == "log_softmax":
+            # stable form x - max - log(sum(exp(x - max))): log(softmax(x))
+            # returns -inf for any entry that underflows
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            x = args[0]
+            mx = ff.reduce_max(x, [axis], keepdims=True, name=f"{name}_mx")
+            sh = ff.subtract(x, mx, name=f"{name}_sh")
+            lse = ff.log(ff.reduce_sum(ff.exp(sh, name=f"{name}_e"),
+                                       [axis], keepdims=True,
+                                       name=f"{name}_s"),
+                         name=f"{name}_lse")
+            return ff.subtract(sh, lse, name=name)
+        if target in ("amax", "max"):
+            from flexflow_tpu.tensor import Tensor as FFTensor
+
+            if (target == "max" and len(args) > 1
+                    and isinstance(args[1], FFTensor)):
+                # binary elementwise torch.max(a, b)
+                return ff.max(args[0], args[1], name=name)
+            axes = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            if axes is None:
+                raise NotImplementedError(
+                    "full-reduction max() has no translation; pass dim=")
+            axes = [axes] if isinstance(axes, int) else list(axes)
+            out = ff.reduce_max(args[0], axes,
+                                keepdims=kwargs.get("keepdim", False),
+                                name=name)
+            # torch.max(x, dim) returns (values, indices); amax just values
+            return out if target == "amax" else (out, None)
+        if target in ("add_", "mul_", "sub_", "div_"):
+            return self._emit_function(ff, target[:-1].replace("div", "truediv"),
+                                       args, kwargs, name)
+        if target == "rsub":  # rsub(x, y, alpha) = y - alpha*x
+            from flexflow_tpu.tensor import Tensor as FFTensor
+
+            alpha = float(kwargs.get("alpha",
+                                     args[2] if len(args) > 2 else 1.0))
+            scaled = (args[0] if alpha == 1.0
+                      else ff.scalar_multiply(args[0], alpha,
+                                              name=f"{name}_a"))
+            if isinstance(args[1], FFTensor):
+                return ff.subtract(args[1], scaled, name=name)
+            neg = ff.scalar_multiply(scaled, -1.0, name=f"{name}_neg")
+            return ff.scalar_add(neg, float(args[1]), name=name)
+        if target == "scaled_dot_product_attention":
+            # F.scaled_dot_product_attention(q, k, v, attn_mask=None,
+            # dropout_p=0, is_causal=False, *, scale=None): q,k,v
+            # [B, H, S, D]. Positional mask/dropout must not be silently
+            # dropped.
+            q, k, v = args[0], args[1], args[2]
+            attn_mask = kwargs.get("attn_mask",
+                                   args[3] if len(args) > 3 else None)
+            dropout_p = float(kwargs.get(
+                "dropout_p", args[4] if len(args) > 4 else 0.0))
+            is_causal = bool(kwargs.get(
+                "is_causal", args[5] if len(args) > 5 else False))
+            if attn_mask is not None or dropout_p:
+                raise NotImplementedError(
+                    "sdpa: attn_mask/dropout_p have no translation yet")
+            d = q.shape[-1]
+            scale = kwargs.get("scale") or 1.0 / float(d) ** 0.5
+            s = ff.einsum("bhqd,bhkd->bhqk", [q, k], name=f"{name}_qk")
+            s = ff.scalar_multiply(s, float(scale), name=f"{name}_scale")
+            if is_causal:
+                tri = np.tril(np.ones((q.shape[2], k.shape[2]),
+                                      np.float32))
+                mask = ff.constant(tri, name=f"{name}_mask")
+                neg = ff.constant(
+                    np.full(tuple(s.shape), -1e30, np.float32),
+                    name=f"{name}_neg")
+                s = ff.where(mask, s, neg, name=f"{name}_masked")
+            p = ff.softmax(s, axis=-1, name=f"{name}_p")
+            return ff.einsum("bhqk,bhkd->bhqd", [p, v], name=name)
         raise NotImplementedError(f"fx target {target!r} has no translation")
 
     # ---- weight transfer --------------------------------------------------
@@ -599,11 +811,17 @@ class PyTorchModel:
             elif isinstance(mod, nn.Embedding):
                 ff.set_parameter(name, mod.weight.detach().numpy(), "kernel")
                 copied += 1
-            elif isinstance(mod, (nn.LayerNorm, nn.BatchNorm2d)):
+            elif isinstance(mod, (nn.LayerNorm, nn.BatchNorm2d,
+                                  nn.GroupNorm)):
                 if getattr(mod, "weight", None) is not None:
                     ff.set_parameter(name, mod.weight.detach().numpy(),
                                      "scale")
                     ff.set_parameter(name, mod.bias.detach().numpy(), "bias")
+                    copied += 1
+            elif hasattr(nn, "RMSNorm") and isinstance(mod, nn.RMSNorm):
+                if getattr(mod, "weight", None) is not None:
+                    ff.set_parameter(name, mod.weight.detach().numpy(),
+                                     "scale")
                     copied += 1
             elif isinstance(mod, nn.MultiheadAttention):
                 copied += self._copy_mha(ff, name, mod)

@@ -1,0 +1,114 @@
+"""Numerically-exact post-import graph transforms.
+
+``fold_conv_batchnorm`` plays the role of the reference's Conv+BN fold
+family in inference graph optimization — but as an EXPLICIT pass over a
+compiled model with live weights, not an automatic search rewrite:
+rewrites re-initialize replaced ops' parameters (their weights arrive
+only after compile), and the fold is only interesting for PRETRAINED
+inference, so the automatic form would silently produce wrong numerics.
+Here the fold computes
+
+    k' = k * (gamma / sqrt(var + eps))        per output channel
+    b' = beta + (b - mean) * gamma / sqrt(var + eps)
+
+from the model's live BN parameters and running stats, removes the BN
+layer (folding its fused relu into the conv's activation), recompiles,
+and installs the folded weights — bit-for-bit the same function with one
+op fewer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flexflow_tpu.ffconst import ActiMode, CompMode, OperatorType
+
+
+def fold_conv_batchnorm(ff) -> int:
+    """Fold every Conv2D -> BatchNorm pair in a compiled INFERENCE model.
+    Returns the number of folds performed. The model is recompiled; all
+    other weights are carried over."""
+    if ff.config.computation_mode != CompMode.INFERENCE:
+        raise ValueError(
+            "fold_conv_batchnorm requires CompMode.INFERENCE: under "
+            "training the BN statistics are batch-dependent and cannot "
+            "fold into constants")
+
+    # tensor guid -> consumer layers
+    consumers = {}
+    for layer in ff.layers:
+        for t in layer.inputs:
+            consumers.setdefault(t.guid, []).append(layer)
+
+    pairs = []
+    for bn in ff.layers:
+        if bn.op_type != OperatorType.BATCHNORM:
+            continue
+        src = bn.inputs[0].owner_layer
+        if (src is not None and src.op_type == OperatorType.CONV2D
+                and src.properties.get("activation",
+                                       ActiMode.AC_MODE_NONE)
+                in (ActiMode.AC_MODE_NONE, None)
+                and len(consumers.get(bn.inputs[0].guid, [])) == 1):
+            pairs.append((src, bn))
+    if not pairs:
+        return 0
+
+    # live weights BEFORE the graph changes
+    folded = {}
+    for conv, bn in pairs:
+        k = ff.get_parameter(conv.name, "kernel")          # [O, I, KH, KW]
+        b = (ff.get_parameter(conv.name, "bias")
+             if conv.properties.get("use_bias", True)
+             else np.zeros((k.shape[0],), np.float32))
+        gamma = ff.get_parameter(bn.name, "scale")
+        beta = ff.get_parameter(bn.name, "bias")
+        st = ff.state.get(bn.name, {})
+        mean = np.asarray(st.get("mean", np.zeros_like(gamma)))
+        var = np.asarray(st.get("var", np.ones_like(gamma)))
+        eps = bn.properties.get("eps", 1e-5)
+        g = gamma / np.sqrt(var + eps)
+        folded[conv.name] = (
+            k * g[:, None, None, None],
+            beta + (b - mean) * g,
+            bool(bn.properties.get("relu", True)),
+        )
+
+    # graph surgery: drop BN layers, rewire their consumers to the conv
+    # output, upgrade the conv (bias + folded relu)
+    others = []  # non-conv/bn params to carry over
+    for lname, sub in ff.params.items():
+        if lname not in folded and not any(bn.name == lname
+                                           for _, bn in pairs):
+            others.append((lname, {p: np.asarray(v)
+                                   for p, v in sub.items()}))
+    bn_names = {bn.name for _, bn in pairs}
+    remap = {bn.outputs[0].guid: conv.outputs[0] for conv, bn in pairs}
+    ff.layers = [l for l in ff.layers if l.name not in bn_names]
+    for layer in ff.layers:
+        layer.inputs = [remap.get(t.guid, t) for t in layer.inputs]
+    for conv, bn in pairs:
+        conv.properties["use_bias"] = True
+        if folded[conv.name][2]:
+            conv.properties["activation"] = ActiMode.AC_MODE_RELU
+    if getattr(ff, "outputs", None) is not None:
+        out = ff.outputs
+        if out is not None and out.guid in remap:
+            ff.outputs = remap[out.guid]
+
+    metric_types = list(ff.metrics.metrics)
+    ff.compile(ff.optimizer, ff.loss_type, metric_types,
+               comp_mode=CompMode.INFERENCE,
+               machine_spec=ff.machine_spec, mesh=ff.mesh)
+
+    for lname, sub in others:
+        for pname, value in sub.items():
+            try:
+                ff.set_parameter(lname, value, pname)
+            except (KeyError, ValueError):
+                pass  # layer reshaped/absent after recompile
+    for conv, _bn in pairs:
+        k, b, _relu = folded[conv.name]
+        ff.set_parameter(conv.name, np.asarray(k, np.float32), "kernel")
+        ff.set_parameter(conv.name, np.asarray(b, np.float32), "bias")
+    return len(pairs)

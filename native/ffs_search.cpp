@@ -22,6 +22,8 @@
 // Input / output: JSON (see flexflow_tpu/search/unity.py for the schema).
 #include <algorithm>
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <queue>
@@ -597,6 +599,12 @@ Json optimize(const Json& req) {
     const Json& rj = req.get("subst_rules");
     if (!rj.is_null())
       for (SubstRule& r : parse_rules(rj)) rules.push_back(std::move(r));
+    if (cfg.training)
+      rules.erase(std::remove_if(rules.begin(), rules.end(),
+                                 [](const SubstRule& r) {
+                                   return r.inference_only;
+                                 }),
+                  rules.end());
   }
   int graphs_evaluated = 1, expansions = 0;
   if (!rules.empty() && best.ok && !g0.nodes.empty()) {
@@ -620,10 +628,13 @@ Json optimize(const Json& req) {
       if (cur.cost > best.time * alpha) break;
       ++expansions;
       for (const SubstRule& rule : rules) {
+        int dbg_matches = 0, dbg_applied = 0;
         for (const Match& match : find_matches(cur.g, rule)) {
+          ++dbg_matches;
           RewriteTraceEntry entry;
           auto ng = apply_rule(cur.g, rule, match, &next_guid, &entry);
           if (!ng) continue;
+          ++dbg_applied;
           // chase the designated output through the rewrite; a rule that
           // drops it unmapped would train on the wrong tensor — reject
           std::pair<int64_t, int> fin = cur.fin;
@@ -661,6 +672,9 @@ Json optimize(const Json& req) {
           if (ev.time <= best.time * alpha && pq.size() < 256)
             pq.push({ev.time, std::move(*ng), std::move(trace), fin});
         }
+        if (dbg_matches && getenv("FFS_DEBUG"))
+          fprintf(stderr, "[ffs] rule %s: %d matches, %d applied\n",
+                  rule.name.c_str(), dbg_matches, dbg_applied);
       }
     }
   }

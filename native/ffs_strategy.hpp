@@ -396,6 +396,49 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
       // is the broadcast cost, charged on the input edge
       out.push_back(std::move(c));
     }
+  } else if (t == "FUSED_PARALLEL") {
+    // fuse_parallel_ops result (substitution.cc:1925 analog): the whole
+    // chain is ONE boundary — compose the steps into the final layout and
+    // charge a single reshard at the producer edge (vs the two separate
+    // collectives the unfused pair priced — the reason fusing wins)
+    const Json& steps = n.attrs.get("ops");
+    if (!steps.is_null() && orank > 0) {
+      Spec sp_ = rep_spec(orank);
+      bool legal = true;
+      for (const Json& st_ : steps.items()) {
+        std::string kind = st_[0].as_string();
+        int64_t dim = st_[1].as_int(0);
+        int64_t deg = st_[2].as_int(1);
+        int8_t ax = dim == 0 ? kData : kModel;
+        if (kind == "REPARTITION") {
+          if (dim < 0 || dim >= (int64_t)orank ||
+              mesh.axis_size(ax) != deg || oshp[dim] % deg) {
+            legal = false;
+            break;
+          }
+          sp_[dim] = ax;
+        } else if (kind == "COMBINE") {
+          if (dim < 0 || dim >= (int64_t)orank ||
+              mesh.axis_size(ax) != deg) {
+            legal = false;
+            break;
+          }
+          sp_[dim] = kRep;
+        } else if (kind == "REPLICATE") {
+          sp_ = rep_spec(orank);
+        } else {
+          legal = false;
+          break;
+        }
+      }
+      if (legal) {
+        out.clear();
+        Choice c = base_choice("fused_constrain");
+        c.out[0] = sp_;
+        c.in[0] = sp_;  // one reshard, charged at the producer edge
+        out.push_back(std::move(c));
+      }
+    }
   } else if (t == "EXPERTS" && mesh.ep > 1) {
     // expert parallelism: the stacked expert weights [E, ...] shard over
     // the 'expert' mesh axis; token dispatch/combine is the

@@ -52,6 +52,9 @@ struct SubstRule {
   std::vector<SubstOp> src, dst;
   // (srcOpId, srcTsId, dstOpId, dstTsId)
   std::vector<std::array<int, 4>> mapped;
+  // semantics-gated rules (e.g. Conv+BatchNorm fold uses running stats):
+  // only legal when the search runs in inference mode
+  bool inference_only = false;
 };
 
 // ---- loaders --------------------------------------------------------------
@@ -102,6 +105,7 @@ inline std::vector<SubstRule> parse_rules(const Json& j) {
                           (int)mj.get("srcTsId").as_int(0),
                           (int)mj.get("dstOpId").as_int(),
                           (int)mj.get("dstTsId").as_int(0)});
+    r.inference_only = rj.get("inference_only").as_bool(false);
     rules.push_back(std::move(r));
   }
   return rules;
@@ -172,6 +176,72 @@ inline std::vector<SubstRule> builtin_rules() {
              {"SPLIT", {{0, 0}}, pm({{"PM_NUM_OUTPUTS", 2.0}})}};
     r.mapped = {{0, 0, 1, 0}, {1, 0, 1, 1}};
     rules.push_back(std::move(r));
+  }
+  {
+    // QKV-projection merge: THREE same-input Linears -> one wide Linear
+    // + 3-way Split (r4 algebraic family; generalizes
+    // fuse_parallel_linears — the transformer q/k/v pattern)
+    SubstRule r;
+    r.name = "fuse_parallel_linears3";
+    r.src = {{"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", wildcard(2)}})},
+             {"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", wildcard(2)}})},
+             {"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", wildcard(2)}})}};
+    r.dst = {{"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", wildcard(2)},
+                                       {"PM_MERGE", 1.0}})},
+             {"SPLIT", {{0, 0}}, pm({{"PM_NUM_OUTPUTS", 3.0}})}};
+    r.mapped = {{0, 0, 1, 0}, {1, 0, 1, 1}, {2, 0, 1, 2}};
+    rules.push_back(std::move(r));
+  }
+  {
+    // activation-epilogue fusion: LINEAR(none) -> act  =>  LINEAR(act).
+    // On TPU the activation runs in the matmul's epilogue fusion — the
+    // standalone op's dispatch + HBM round-trip disappears (r4 family).
+    struct ActKind { const char* op; double acti; };
+    for (ActKind a : {ActKind{"RELU", 1.0}, ActKind{"SIGMOID", 2.0},
+                      ActKind{"TANH", 3.0}, ActKind{"GELU", 4.0}}) {
+      SubstRule r;
+      r.name = std::string("fuse_linear_") + a.op;
+      r.src = {{"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", 0.0}})},
+               {a.op, {{0, 0}}, {}}};
+      r.dst = {{"LINEAR", {{-1, 0}}, pm({{"PM_ACTI", a.acti}})}};
+      r.mapped = {{1, 0, 0, 0}};
+      rules.push_back(std::move(r));
+    }
+  }
+  {
+    // fuse_parallel_ops (reference substitution.cc:1925): adjacent
+    // parallel-op chains collapse into ONE FusedParallelOp boundary — a
+    // single reshard instead of two sequential collectives.
+    for (int d1 = 0; d1 < 3; ++d1) {
+      for (int d2 = 0; d2 < 3; ++d2) {
+        if (d1 == d2) continue;
+        // Repartition(d1) -> Combine(d2): move shards between dims
+        SubstRule r;
+        r.name = "fuse_parallel_ops_part" + std::to_string(d1) + "_comb" +
+                 std::to_string(d2);
+        r.src = {{"REPARTITION", {{-1, 0}},
+                  pm({{"PM_PARALLEL_DIM", (double)d1},
+                      {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+                 {"COMBINE", {{0, 0}},
+                  pm({{"PM_PARALLEL_DIM", (double)d2},
+                      {"PM_PARALLEL_DEGREE", wildcard(3)}})}};
+        r.dst = {{"FUSED_PARALLEL", {{-1, 0}}, {}}};
+        r.mapped = {{1, 0, 0, 0}};
+        rules.push_back(std::move(r));
+      }
+    }
+    // Combine(d) -> Replicate: gather + broadcast in one boundary
+    for (int d = 0; d < 3; ++d) {
+      SubstRule r;
+      r.name = "fuse_parallel_ops_comb" + std::to_string(d) + "_repl";
+      r.src = {{"COMBINE", {{-1, 0}},
+                pm({{"PM_PARALLEL_DIM", (double)d},
+                    {"PM_PARALLEL_DEGREE", wildcard(1)}})},
+               {"REPLICATE", {{0, 0}}, {}}};
+      r.dst = {{"FUSED_PARALLEL", {{-1, 0}}, {}}};
+      r.mapped = {{1, 0, 0, 0}};
+      rules.push_back(std::move(r));
+    }
   }
   {
     // move Combines past a binary op: Combine(a)+Combine(b) -> EW op
@@ -292,6 +362,11 @@ inline std::optional<double> node_param(const Node& n, const std::string& key) {
     const Json& v = n.attrs.get("axis");
     if (!v.is_null()) return v.as_double();
     return std::nullopt;
+  }
+  if (key == "PM_RELU") {
+    const Json& v = n.attrs.get("relu");
+    if (!v.is_null()) return v.as_double();
+    return 0.0;
   }
   if (key == "PM_NUM_INPUTS") return (double)n.inputs.size();
   if (key == "PM_NUM_OUTPUTS") return (double)n.output_shapes.size();
@@ -566,6 +641,49 @@ inline std::optional<Graph> apply_rule(const Graph& g, const SubstRule& rule,
       n.output_shapes = base->output_shapes;
       n.fwd_flops = base->fwd_flops;
       n.params = base->params;
+      // BN-fold overrides: the folded conv gains a bias and possibly the
+      // BN's fused relu
+      double acti = para_val(dop, "PM_ACTI", -1.0);
+      double ub = para_val(dop, "PM_USE_BIAS", -1.0);
+      if (acti >= 0 || ub >= 0) {
+        Json attrs = n.attrs;
+        if (acti >= 0) attrs.set("activation", Json(acti));
+        if (ub >= 0) attrs.set("use_bias", Json((int64_t)ub));
+        n.attrs = attrs;
+        if (ub > 0 && !n.params.count("bias") && !n.output_shapes.empty() &&
+            n.output_shapes[0].size() == 4)
+          n.params["bias"] = {n.output_shapes[0][1]};  // NCHW channels
+      }
+    } else if (t == "FUSED_PARALLEL") {
+      // fuse_parallel_ops: collapse the matched parallel-op chain into
+      // one boundary. Steps come from the matched src ops in pattern
+      // order; only non-REDUCTION steps are generated (shape-preserving).
+      if (in_shapes.size() != 1) return std::nullopt;
+      Json steps = Json::array();
+      for (size_t si = 0; si < rule.src.size(); ++si) {
+        const std::string& st_ = rule.src[si].type;
+        if (st_ != "REPARTITION" && st_ != "COMBINE" && st_ != "REPLICATE")
+          continue;
+        const Node& sn = g.nodes[match.node_of[si]];
+        int64_t dim = sn.attrs.get("dim").as_int(0);
+        int64_t deg = sn.attrs.get("degree").as_int(1);
+        Json step = Json::array();
+        step.push_back(Json(st_));
+        step.push_back(Json(dim));
+        step.push_back(Json(deg));
+        if (st_ == "REPARTITION" &&
+            (dim < 0 || dim >= (int64_t)in_shapes[0].size() || deg <= 0 ||
+             in_shapes[0][dim] % deg))
+          return std::nullopt;
+        steps.push_back(step);
+      }
+      if (steps.items().empty()) return std::nullopt;
+      Json attrs = Json::object();
+      attrs.set("ops", steps);
+      n.attrs = attrs;
+      n.output_shapes = {in_shapes[0]};
+      n.fwd_flops = 0;
+      n.params.clear();
     } else if (t == "EW_ADD" || t == "EW_MUL") {
       if (in_shapes.size() != 2) return std::nullopt;
       // broadcast

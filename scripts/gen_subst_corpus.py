@@ -100,6 +100,53 @@ def generate():
              op("COMBINE", [(0, 0)], pdim(d=d))],
             [op("IDENTITY", [(-1, 0)])],
             [(1, 0, 0, 0)]))
+    # --- r4 algebraic compute-rewrite families -------------------------
+    # family 7: N same-input Linears -> one wide Linear + N-way Split
+    # (N=3 is the transformer QKV-projection merge)
+    for nway in (2, 3, 4):
+        rules.append(rule(
+            f"corpus_fuse_parallel_linears{nway}",
+            [op("LINEAR", [(-1, 0)], {"PM_ACTI": WILD(2)})
+             for _ in range(nway)],
+            [op("LINEAR", [(-1, 0)], {"PM_ACTI": WILD(2), "PM_MERGE": 1.0}),
+             op("SPLIT", [(0, 0)], {"PM_NUM_OUTPUTS": float(nway)})],
+            [(i, 0, 1, i) for i in range(nway)]))
+    # family 8: activation-epilogue fusion: Linear(none) -> act
+    # => Linear(act) — the activation rides the matmul's epilogue
+    for act_op, acti in (("RELU", 1.0), ("SIGMOID", 2.0), ("TANH", 3.0),
+                         ("GELU", 4.0)):
+        rules.append(rule(
+            f"corpus_fuse_linear_{act_op}",
+            [op("LINEAR", [(-1, 0)], {"PM_ACTI": 0.0}),
+             op(act_op, [(0, 0)])],
+            [op("LINEAR", [(-1, 0)], {"PM_ACTI": acti})],
+            [(1, 0, 0, 0)]))
+    # family 9 (Conv+BatchNorm fold) deliberately NOT an automatic rewrite:
+    # rewrites re-initialize replaced ops' weights, and the fold only
+    # matters for PRETRAINED inference — the numerically-exact fold is the
+    # explicit post-import pass flexflow_tpu.transforms.fold_conv_batchnorm.
+    # family 10: fuse_parallel_ops (reference substitution.cc:1925) —
+    # adjacent parallel-op chains collapse into one FusedParallelOp
+    # boundary (a single reshard instead of two collectives)
+    for d1 in range(3):
+        for d2 in range(3):
+            if d1 == d2:
+                continue
+            rules.append(rule(
+                f"corpus_fuse_parallel_ops_part{d1}_comb{d2}",
+                [op("REPARTITION", [(-1, 0)], pdim(d=d1)),
+                 op("COMBINE", [(0, 0)],
+                    {"PM_PARALLEL_DIM": float(d2),
+                     "PM_PARALLEL_DEGREE": WILD(3)})],
+                [op("FUSED_PARALLEL", [(-1, 0)])],
+                [(1, 0, 0, 0)]))
+    for d in range(3):
+        rules.append(rule(
+            f"corpus_fuse_parallel_ops_comb{d}_repl",
+            [op("COMBINE", [(-1, 0)], pdim(d=d)),
+             op("REPLICATE", [(0, 0)])],
+            [op("FUSED_PARALLEL", [(-1, 0)])],
+            [(1, 0, 0, 0)]))
     return rules
 
 

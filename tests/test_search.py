@@ -70,8 +70,11 @@ class TestNativeSearch:
         resp = native_optimize({"machine": MACHINE, "config": _cfg(),
                                 "measured": {}, "nodes": nodes})
         assert resp["mesh"]["model"] > 1
-        kspec = resp["ops"]["1"]["params"]["kernel"]
-        assert "model" in kspec
+        # guids may shift when a rewrite fires (e.g. fuse_linear_RELU
+        # merges the activation into the matmul) — find any Linear kernel
+        kspecs = [oj["params"]["kernel"] for oj in resp["ops"].values()
+                  if "kernel" in oj.get("params", {})]
+        assert kspecs and any("model" in ks for ks in kspecs), resp["ops"]
 
     def test_only_data_parallel_flag(self):
         nodes = mlp_graph(b=8, d=8192, h=8192)
@@ -163,7 +166,8 @@ class TestNativeSearch:
         nodes = mlp_graph(b=8, d=8192, h=8192)
         resp = native_optimize({
             "machine": MACHINE,
-            "config": _cfg(rules=[{"op_type": "LINEAR", "allow": ["rep", "dp"]}]),
+            "config": _cfg(rules=[{"op_type": "LINEAR", "allow": ["rep", "dp"]}],
+                           enable_substitution=False),
             "measured": {}, "nodes": nodes})
         for g in ("1", "3"):
             assert resp["ops"][g]["choice"] in ("rep", "dp")
@@ -343,7 +347,7 @@ class TestMultiSlice:
 
     def test_lowering_dcn_bw_flips_strategy(self):
         nodes = self._mlp()
-        cfg = _cfg(budget=2, batch=4096)
+        cfg = _cfg(budget=2, batch=4096, enable_substitution=False)
         fast = native_optimize({"machine": self._machine(25e9),
                                 "config": cfg, "measured": {},
                                 "nodes": nodes, "final": [3, 0]})
@@ -408,12 +412,14 @@ class TestSampleParallel:
     def test_two_d_sample_partition_wins(self):
         nodes, b = self._graph()
         on = native_optimize({"machine": MACHINE,
-                              "config": _cfg(budget=2, batch=b),
+                              "config": _cfg(budget=2, batch=b,
+                                             enable_substitution=False),
                               "measured": {}, "nodes": nodes,
                               "final": [2, 0]})
         off = native_optimize({"machine": MACHINE,
                                "config": _cfg(budget=2, batch=b,
-                                              enable_sample_parallel=False),
+                                              enable_sample_parallel=False,
+                                              enable_substitution=False),
                                "measured": {}, "nodes": nodes,
                                "final": [2, 0]})
         assert on["ops"]["2"]["choice"] == "sample2", on["ops"]
@@ -429,6 +435,7 @@ class TestSampleParallel:
 
         cfg = FFConfig(batch_size=64, search_budget=2,
                        enable_parameter_parallel=True)
+        cfg.enable_substitution = False  # probe sample2, not rewrites
         ff = FFModel(cfg)
         t = ff.create_tensor((64, 64))
         h = ff.dense(t, 33, name="row")   # odd out_dim: no col/mp_last

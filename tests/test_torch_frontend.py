@@ -262,3 +262,157 @@ class TestFunctionalPooling:
         want = m(torch.from_numpy(x)).detach().numpy()
         got = ff.predict(x)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---- r4 depth: GPT-2-class module + new translation kinds ------------------
+
+class MiniGPT2(nn.Module):
+    """GPT-2-style causal block written with plain torch ops: packed qkv
+    Linear + chunk(3) + view/transpose + matmul + additive causal-mask
+    buffer (get_attr) + softmax + GELU MLP. The shape of module the
+    reference's HF-aware tracer targeted (torch/model.py:2424-2444)."""
+
+    def __init__(self, e=32, h=4, s=8):
+        super().__init__()
+        self.e, self.h, self.s = e, h, s
+        self.ln_1 = nn.LayerNorm(e)
+        self.c_attn = nn.Linear(e, 3 * e)
+        self.c_proj = nn.Linear(e, e)
+        self.ln_2 = nn.LayerNorm(e)
+        self.mlp_fc = nn.Linear(e, 4 * e)
+        self.mlp_proj = nn.Linear(4 * e, e)
+        bias = (1.0 - torch.tril(torch.ones(s, s))) * -1e9
+        self.register_buffer("attn_bias", bias.view(1, 1, s, s))
+
+    def forward(self, x):
+        b = x.shape[0]
+        e, h, s = self.e, self.h, self.s
+        d = e // h
+        a = self.ln_1(x)
+        qkv = self.c_attn(a)
+        q, k, v = qkv.chunk(3, dim=2)
+        q = q.view(b, s, h, d).transpose(1, 2)
+        k = k.view(b, s, h, d).transpose(1, 2)
+        v = v.view(b, s, h, d).transpose(1, 2)
+        att = torch.matmul(q, k.transpose(2, 3)) * (1.0 / d ** 0.5)
+        att = att + self.attn_bias
+        att = torch.softmax(att, dim=-1)
+        y = torch.matmul(att, v)
+        y = y.transpose(1, 2).reshape(b, s, e)
+        x = x + self.c_proj(y)
+        m = self.mlp_proj(torch.nn.functional.gelu(self.mlp_fc(self.ln_2(x))))
+        return x + m
+
+
+class TestGPT2ClassModule:
+    def test_traces_matches_and_trains(self):
+        torch.manual_seed(0)
+        m = MiniGPT2().eval()
+        ff, ptm, _ = build_ff(m, (8, 32), batch=4)
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(0).randn(4, 8, 32).astype(np.float32)
+        ours = ff.predict(x)
+        theirs = m(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+        # trains one step without error and the loss is finite
+        y = np.random.RandomState(1).randn(4, 8, 32).astype(np.float32)
+        ff.fit(x, y, epochs=1, verbose=False)
+        assert np.isfinite(ff.predict(x)).all()
+
+
+class NewKindsNet(nn.Module):
+    """Exercises einsum, masked_fill, where, clamp, expand, abs,
+    log_softmax, amax in one traced module."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(16, 16)
+        self.register_buffer("mask",
+                             (torch.arange(16) % 2 == 0).float())
+
+    def forward(self, x):
+        h = self.fc(x)
+        h = h.masked_fill(self.mask > 0.5, 0.25)
+        h = torch.clamp(h, min=-2.0, max=2.0)
+        g = torch.einsum("bi,bj->bij", h, h)
+        g = g.amax(dim=2)
+        g = torch.abs(g)
+        z = torch.where(self.mask > 0.5, g, h)
+        return torch.log_softmax(z, dim=-1)
+
+
+class TestNewTranslationKinds:
+    def test_new_kinds_alignment(self):
+        torch.manual_seed(0)
+        m = NewKindsNet().eval()
+        ff, ptm, _ = build_ff(m, (16,), batch=8)
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(2).randn(8, 16).astype(np.float32)
+        ours = ff.predict(x)
+        theirs = m(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_group_norm_and_silu(self):
+        class GN(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2d(8, 8, 3, padding=1)
+                self.gn = nn.GroupNorm(4, 8)
+                self.act = nn.SiLU()
+
+            def forward(self, x):
+                return self.act(self.gn(self.conv(x)))
+
+        torch.manual_seed(0)
+        m = GN().eval()
+        ff, ptm, _ = build_ff(m, (8, 8, 8), batch=4)
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(3).randn(4, 8, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(ff.predict(x),
+                                   m(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_sdpa_function(self):
+        class SDPA(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.qkv = nn.Linear(32, 96)
+
+            def forward(self, x):  # x [B, 4, 8, 32] as [B,H,S,E']
+                q, k, v = self.qkv(x).chunk(3, dim=-1)
+                return torch.nn.functional.scaled_dot_product_attention(
+                    q, k, v, is_causal=True)
+
+        torch.manual_seed(0)
+        m = SDPA().eval()
+        ff, ptm, _ = build_ff(m, (4, 8, 32), batch=2)
+        ptm.copy_weights_to(ff)
+        x = np.random.RandomState(4).randn(2, 4, 8, 32).astype(np.float32)
+        np.testing.assert_allclose(ff.predict(x),
+                                   m(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=2e-3, atol=2e-4)
+
+
+class TestHFStateDictPath:
+    def test_llama_from_torch_weights_through_frontend(self):
+        transformers = pytest.importorskip("transformers")
+        from flexflow_tpu.torch.model import from_hf_causal_lm
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            rms_norm_eps=1e-6, rope_theta=10000.0,
+            attention_bias=False, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        ff, load = from_hf_causal_lm(hf, batch_size=2, seq_length=8)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        assert load() == 3 + 9 * 2
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 64, (2, 8)).astype(np.int32)
+        want = hf(torch.from_numpy(ids.astype(np.int64))
+                  ).logits.detach().numpy()
+        np.testing.assert_allclose(ff.predict(ids), want,
+                                   rtol=2e-3, atol=2e-3)
