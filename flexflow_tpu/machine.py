@@ -87,6 +87,16 @@ class MachineSpec:
     dcn_latency: float = 10e-6
     mxu_efficiency: float = 0.55  # achieved fraction of peak on real shapes
     min_op_time: float = 5e-7     # per-kernel dispatch overhead (seconds)
+    # Arbitrary inter-slice fabric (the reference NetworkedMachineModel's
+    # role, simulator.h:515 + network.cc ECMP routing, re-expressed
+    # TPU-first): explicit slice-pair links [(i, j, bytes_per_s), ...].
+    # None = uniform all-to-all at dcn_bw. Cross-slice ring collectives
+    # are bottleneck-bound, so the topology reduces to an effective
+    # (bandwidth, latency) for the slice ring: per consecutive pair the
+    # shortest path is routed (missing direct links hop through
+    # intermediate slices), the pair's bandwidth is the min link on the
+    # path, and the ring's effective bandwidth is the bottleneck pair.
+    dcn_links: Optional[Sequence[Tuple[int, int, float]]] = None
 
     def __post_init__(self):
         if self.torus is None:
@@ -125,6 +135,11 @@ class MachineSpec:
         "nvlink_latency": ("ici_latency", lambda v: float(v) * 1e-3),
         "nic_bandwidth": ("dcn_bw", lambda v: float(v) * 1e9),
         "nic_latency": ("dcn_latency", lambda v: float(v) * 1e-3),
+        # arbitrary inter-slice fabric: [[i, j, bytes_per_s], ...]
+        # (NetworkedMachineModel's adjacency-matrix role, simulator.h:515)
+        "dcn_links": ("dcn_links",
+                      lambda v: [(int(i), int(j), float(bw))
+                                 for i, j, bw in v]),
     }
 
     @classmethod
@@ -149,7 +164,13 @@ class MachineSpec:
                 if "=" not in line:
                     continue
                 k, v = (s.strip() for s in line.split("=", 1))
-                values[k] = v
+                if k == "dcn_link":
+                    # repeatable: "dcn_link = i j bytes_per_s"
+                    i, j, bw = v.split()
+                    values.setdefault("dcn_links", []).append(
+                        [int(i), int(j), float(bw)])
+                else:
+                    values[k] = v
         init = {}
         overrides = {}
         field_names = {f.name for f in dataclasses.fields(cls)}
@@ -171,6 +192,55 @@ class MachineSpec:
     @property
     def num_devices(self) -> int:
         return self.chips_per_slice * self.num_slices
+
+    def effective_dcn(self) -> Tuple[float, float]:
+        """(bandwidth, latency) of the cross-slice ring under the
+        explicit fabric, or the uniform defaults when none is given.
+
+        For each consecutive ring pair (i, i+1 mod S): route the
+        shortest path over the link graph (ECMP-role reduction:
+        hop-count shortest, bottleneck bandwidth); the ring is paced by
+        its slowest pair, and latency scales with the longest routed
+        path. Unreachable pairs fall back to the uniform dcn_bw with a
+        2-hop penalty (the fabric must be connected through a spine)."""
+        if not self.dcn_links or self.num_slices <= 1:
+            return self.dcn_bw, self.dcn_latency
+        S = self.num_slices
+        adj: Dict[int, Dict[int, float]] = {i: {} for i in range(S)}
+        for i, j, bw in self.dcn_links:
+            i, j, bw = int(i), int(j), float(bw)
+            if i == j or i >= S or j >= S:
+                continue
+            adj[i][j] = max(adj[i].get(j, 0.0), bw)
+            adj[j][i] = max(adj[j].get(i, 0.0), bw)
+
+        def route(a: int, b: int) -> Tuple[int, float]:
+            """(hops, bottleneck bw) of the hop-shortest (then
+            widest-bottleneck) a->b path — Bellman-Ford relaxation."""
+            best = {a: (0, float("inf"))}
+            for _ in range(S):
+                changed = False
+                for u, (h, bw) in list(best.items()):
+                    for v, link_bw in adj[u].items():
+                        cand = (h + 1, min(bw, link_bw))
+                        cur = best.get(v)
+                        if cur is None or cand[0] < cur[0] or (
+                                cand[0] == cur[0] and cand[1] > cur[1]):
+                            best[v] = cand
+                            changed = True
+                if not changed:
+                    break
+            return best.get(b, (2, self.dcn_bw))
+
+        worst_bw = float("inf")
+        worst_hops = 1
+        for i in range(S):
+            hops, bw = route(i, (i + 1) % S)
+            worst_bw = min(worst_bw, bw)
+            worst_hops = max(worst_hops, hops)
+        if not np.isfinite(worst_bw):
+            worst_bw = self.dcn_bw
+        return worst_bw, self.dcn_latency * worst_hops
 
     def ici_allreduce_time(self, bytes_: int, num_chips: int) -> float:
         """Bidirectional-ring allreduce cost over ICI: 2(n-1)/n * B / bw."""

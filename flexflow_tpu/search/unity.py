@@ -110,6 +110,11 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
 
 def machine_to_json(spec, num_devices: int,
                     comm_bytes_factor: float = 1.0) -> Dict[str, Any]:
+    # arbitrary inter-slice fabrics reduce to the ring's bottleneck
+    # (bandwidth, routed latency) — MachineSpec.effective_dcn
+    dcn_bw, dcn_latency = (spec.effective_dcn()
+                           if hasattr(spec, "effective_dcn")
+                           else (spec.dcn_bw, spec.dcn_latency))
     return dict(
         num_devices=num_devices,
         flops=spec.flops,
@@ -117,8 +122,8 @@ def machine_to_json(spec, num_devices: int,
         hbm_cap=spec.hbm_cap,
         ici_bw=spec.ici_bw,
         ici_latency=spec.ici_latency,
-        dcn_bw=spec.dcn_bw,
-        dcn_latency=spec.dcn_latency,
+        dcn_bw=dcn_bw,
+        dcn_latency=dcn_latency,
         num_slices=spec.num_slices,
         mxu_efficiency=getattr(spec, "mxu_efficiency", 0.55),
         min_op_time=getattr(spec, "min_op_time", 5e-7),

@@ -76,13 +76,21 @@ def fold_conv_batchnorm(ff) -> int:
 
     # graph surgery: drop BN layers, rewire their consumers to the conv
     # output, upgrade the conv (bias + folded relu)
+    bn_names = {bn.name for _, bn in pairs}
     others = []  # non-conv/bn params to carry over
     for lname, sub in ff.params.items():
-        if lname not in folded and not any(bn.name == lname
-                                           for _, bn in pairs):
+        if lname not in folded and lname not in bn_names:
             others.append((lname, {p: np.asarray(v)
                                    for p, v in sub.items()}))
-    bn_names = {bn.name for _, bn in pairs}
+    # op state (e.g. running stats of BNs the fold did NOT touch) must
+    # survive the recompile too — compile() reassigns ff.state
+    from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
+    state_save = {
+        lname: {k: np.asarray(v) for k, v in sub.items()}
+        for lname, sub in ff.state.items()
+        if lname not in bn_names and lname != COMPUTE_PARAMS_KEY
+        and isinstance(sub, dict)
+    }
     remap = {bn.outputs[0].guid: conv.outputs[0] for conv, bn in pairs}
     ff.layers = [l for l in ff.layers if l.name not in bn_names]
     for layer in ff.layers:
@@ -107,6 +115,17 @@ def fold_conv_batchnorm(ff) -> int:
                 ff.set_parameter(lname, value, pname)
             except (KeyError, ValueError):
                 pass  # layer reshaped/absent after recompile
+    import jax
+    import jax.numpy as jnp
+    for lname, sub in state_save.items():
+        live = ff.state.get(lname)
+        if not isinstance(live, dict):
+            continue
+        for k, value in sub.items():
+            old = live.get(k)
+            if old is not None and tuple(old.shape) == tuple(value.shape):
+                live[k] = jax.device_put(jnp.asarray(value, old.dtype),
+                                         old.sharding)
     for conv, _bn in pairs:
         k, b, _relu = folded[conv.name]
         ff.set_parameter(conv.name, np.asarray(k, np.float32), "kernel")

