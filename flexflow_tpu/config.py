@@ -29,6 +29,10 @@ class FFConfig:
     # machine shape (reference -ll:gpu / --nodes; here: chips per host, hosts)
     workers_per_node: int = 0  # chips per host; 0 = auto (all visible)
     num_nodes: int = 1  # hosts (DCN-connected)
+    # multi-controller rendezvous (reference: mpirun/GASNet conduit;
+    # here: jax.distributed — auto-detected on TPU pods, explicit on CPU)
+    coordinator_address: Optional[str] = None
+    node_rank: int = -1  # -1 = auto-detect
     memory_per_chip_mb: int = 16 * 1024  # analog of -ll:fsize
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
@@ -117,6 +121,10 @@ class FFConfig:
                 take()  # Legion host-side knobs: accepted, no TPU meaning
             elif a == "--nodes":
                 self.num_nodes = int(take())
+            elif a == "--coordinator-address":
+                self.coordinator_address = take()
+            elif a == "--node-rank":
+                self.node_rank = int(take())
             elif a == "--budget" or a == "--search-budget":
                 self.search_budget = int(take())
             elif a == "--alpha" or a == "--search-alpha":

@@ -34,14 +34,27 @@ class SingleDataLoader:
         self.input_name = input_name  # None => label loader
         arr = np.asarray(full_array)
         bs = batch_size or ffmodel.input_tensors[0].shape[0]
-        self.batch_size = bs
-        self.num_samples = (arr.shape[0] // bs) * bs
-        if self.num_samples == 0:
-            raise ValueError(
-                f"dataset of {arr.shape[0]} samples < batch size {bs}")
-        arr = arr[: self.num_samples]
-        self.num_batches = self.num_samples // bs
+        self.batch_size = bs  # global batch
         sharding = ffmodel.executor.batch_sharding()
+        # multi-host: `full_array` is this process's dataset shard; each
+        # batch consumes the local block of the global batch and the rows
+        # assemble via make_array_from_process_local_data (host-resident —
+        # the on-device staged path needs single-controller addressing)
+        self._multihost = jax.process_count() > 1
+        if self._multihost:
+            from flexflow_tpu import distributed as _dist
+            self._local_bs, _ = _dist.local_batch_rows(sharding, bs)
+            stage_on_device = False
+        else:
+            self._local_bs = bs
+        usable = (arr.shape[0] // self._local_bs) * self._local_bs
+        if usable == 0:
+            raise ValueError(
+                f"dataset of {arr.shape[0]} samples < (local) batch size "
+                f"{self._local_bs}")
+        arr = arr[:usable]
+        self.num_batches = usable // self._local_bs
+        self.num_samples = self.num_batches * bs  # global count
         if stage_on_device:
             self.data = jax.device_put(jnp.asarray(arr), sharding)
         else:
@@ -55,10 +68,16 @@ class SingleDataLoader:
     def next_batch(self, _ff=None):
         """Return the next batch, wrapping around (reference semantics:
         the C++ loader reloads from the start each epoch)."""
-        if self.next_index + self.batch_size > self.num_samples:
+        n_local = self.num_batches * self._local_bs
+        if self.next_index + self._local_bs > n_local:
             self.next_index = 0
         start = self.next_index
-        self.next_index += self.batch_size
+        self.next_index += self._local_bs
+        if self._multihost:
+            from flexflow_tpu import distributed as _dist
+            return _dist.stage_local_batch(
+                self.data[start:start + self._local_bs], self._sharding,
+                global_rows=self.batch_size)
         if isinstance(self.data, np.ndarray):
             # single transfer straight onto the batch sharding
             return jax.device_put(self.data[start:start + self.batch_size],

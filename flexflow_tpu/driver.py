@@ -39,6 +39,11 @@ def main(argv=None) -> int:
         return 2
     rest.remove(script)
     _config = cfg
+    # multi-host launch (--nodes N > 1, one driver process per host):
+    # rendezvous through the JAX distributed runtime before the script
+    # builds any mesh, so jax.devices() spans all hosts
+    from flexflow_tpu import distributed
+    distributed.initialize_from_config(cfg)
     sys.argv = [script] + rest
     runpy.run_path(script, run_name="__main__")
     return 0

@@ -69,6 +69,24 @@ CHIP_SPECS: Dict[str, Dict[str, float]] = {
 }
 
 
+def _factor_torus(n: int, dims: int) -> Tuple[int, ...]:
+    """Near-equal `dims`-way factorization of a slice's chip count into
+    torus extents, largest first (e.g. 32 chips, 3-D -> (4, 4, 2) — the
+    real v4-32 topology). Falls back to fewer dims when n doesn't split."""
+    if n <= 1:
+        return (n,)
+    out = []
+    rem = n
+    for i in range(dims, 1, -1):
+        target = max(1, round(rem ** (1.0 / i)))
+        f = max(d for d in range(1, target + 1) if rem % d == 0)
+        if f > 1:
+            out.append(f)
+            rem //= f
+    out.append(rem)
+    return tuple(sorted((x for x in out if x > 1), reverse=True)) or (n,)
+
+
 @dataclasses.dataclass
 class MachineSpec:
     """One slice (ICI domain) of ``num_nodes`` DCN-connected slices.
@@ -100,12 +118,11 @@ class MachineSpec:
 
     def __post_init__(self):
         if self.torus is None:
-            n = self.chips_per_slice
-            side = int(math.isqrt(n))
-            if side * side == n and n > 1:
-                self.torus = (side, side)
-            else:
-                self.torus = (n,)
+            # default per-generation ICI topology: v4/v5p slices are 3-D
+            # tori, v5e/v6e are 2-D meshes. A 1-tuple means "flat /
+            # unspecified" — the native model prices all axes alike then.
+            dims = 3 if self.chip in ("tpu-v4", "tpu-v5p") else 2
+            self.torus = _factor_torus(self.chips_per_slice, dims)
         spec = CHIP_SPECS[self.chip]
         self.flops = spec["flops"]
         self.hbm_bw = spec["hbm_bw"]
@@ -127,6 +144,10 @@ class MachineSpec:
         "dcn_latency": ("dcn_latency", float),
         "mxu_efficiency": ("mxu_efficiency", float),
         "min_op_time": ("min_op_time", float),
+        # per-slice ICI torus extents: JSON list or "4 2" in key=value form
+        "torus": ("torus",
+                  lambda v: tuple(int(x) for x in
+                                  (v.split() if isinstance(v, str) else v))),
         # reference machine_config_example vocabulary (GB/s, ms):
         # nodes = DCN domains; nvlink = intra-node device link -> ICI;
         # nic = inter-node link -> DCN

@@ -1,0 +1,188 @@
+"""Multi-host (2-process) SPMD dryrun: gradient-sync parity.
+
+Validates the multi-controller execution path without real multi-host
+hardware: spawn N processes, each with `devices_per_proc` virtual CPU
+devices, rendezvous through `jax.distributed` (gloo collectives), train a
+tiny transformer data-parallel over the global mesh with each process
+feeding only its local batch rows — then assert the synced parameters
+match a single-process run on the same global batch.
+
+Analog of the reference's multinode CI harness
+(tests/multinode_helpers/mpi_wrapper1.sh: mpirun -np 2 with per-rank
+GPU masks), re-expressed for JAX multi-controller SPMD.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+_STEPS = 2
+
+
+def _model_config(total_devices: int):
+    from flexflow_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(num_layers=1, hidden_size=32, num_heads=2,
+                             seq_length=8, batch_size=2 * total_devices)
+
+
+def _global_batch(cfg):
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, cfg.seq_length,
+                 cfg.hidden_size).astype(np.float32)
+    y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+    return x, y
+
+
+def _build_and_train(total_devices: int):
+    """Train the dryrun model for _STEPS steps on this process's rows of
+    the fixed global batch; returns the FFModel. Works single-process
+    (feeds the whole batch) and multi-process (feeds the local block)."""
+    import jax
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.machine import make_mesh
+    from flexflow_tpu.models.transformer import create_transformer
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    cfg = _model_config(total_devices)
+    ff = create_transformer(cfg, FFConfig(batch_size=cfg.batch_size))
+    mesh = make_mesh(total_devices, {"data": total_devices})
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [], mesh=mesh)
+    x, y = _global_batch(cfg)
+    pc, pi = jax.process_count(), jax.process_index()
+    rows = x.shape[0] // pc
+    lo = rows * pi
+    ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS, verbose=False)
+    return ff
+
+
+def _params_to_numpy(ff) -> Dict[str, np.ndarray]:
+    flat: Dict[str, np.ndarray] = {}
+
+    def rec(prefix, tree):
+        for k in sorted(tree):
+            v = tree[k]
+            if isinstance(v, dict):
+                rec(f"{prefix}{k}/", v)
+            else:
+                # data-parallel params are replicated => fully addressable
+                flat[f"{prefix}{k}"] = np.asarray(v)
+
+    rec("", ff.params)
+    return flat
+
+
+def worker_main(process_id: int, num_processes: int, port: int,
+                devices_per_proc: int, out_path: str) -> None:
+    """One rendezvous participant (subprocess entry point)."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", devices_per_proc)
+
+    from flexflow_tpu import distributed
+
+    distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+    total = jax.device_count()
+    assert total == num_processes * devices_per_proc, (
+        f"expected {num_processes * devices_per_proc} global devices, "
+        f"got {total}")
+    ff = _build_and_train(total)
+    np.savez(out_path, loss=np.float64(ff._last_loss),
+             **_params_to_numpy(ff))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
+               timeout: int = 600) -> None:
+    """Spawn the workers, train, and assert parity with a single-process
+    run on the same global batch. Raises on any mismatch.
+
+    The calling process must have >= num_processes * devices_per_proc
+    JAX devices for the single-process reference leg."""
+    import jax
+
+    total = num_processes * devices_per_proc
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as td:
+        outs = [os.path.join(td, f"worker{p}.npz")
+                for p in range(num_processes)]
+        procs = []
+        env = dict(os.environ)
+        env["FFS_MP_CHILD"] = "1"
+        env.pop("JAX_PLATFORMS", None)
+        # the per-process backend is configured inside worker_main via
+        # jax config (not env), so a sitecustomize cannot override it
+        env.pop("XLA_FLAGS", None)
+        try:
+            for p in range(num_processes):
+                code = (
+                    "import sys; sys.path.insert(0, %r); "
+                    "from flexflow_tpu.multihost_dryrun import worker_main; "
+                    "worker_main(%d, %d, %d, %d, %r)"
+                    % (repo, p, num_processes, port, devices_per_proc,
+                       outs[p])
+                )
+                procs.append(subprocess.Popen([sys.executable, "-c", code],
+                                              cwd=repo, env=env))
+            rcs = [proc.wait(timeout=timeout) for proc in procs]
+        finally:
+            # a worker that died pre-rendezvous leaves its peer blocked in
+            # jax.distributed.initialize — never orphan it
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(
+                f"multihost dryrun: worker exit codes {rcs}")
+        worker_results = [dict(np.load(o)) for o in outs]
+
+    # single-process reference on the same global batch
+    if len(jax.devices()) < total:
+        raise RuntimeError(
+            f"multihost dryrun needs {total} local devices for the "
+            f"reference leg, have {len(jax.devices())}")
+    ref = _build_and_train(total)
+    ref_params = _params_to_numpy(ref)
+    ref_loss = float(ref._last_loss)
+
+    for p, got in enumerate(worker_results):
+        got_loss = float(got.pop("loss"))
+        if not np.isfinite(got_loss) or abs(got_loss - ref_loss) > 1e-4 * (
+                1.0 + abs(ref_loss)):
+            raise AssertionError(
+                f"worker {p} loss {got_loss} != reference {ref_loss}")
+        missing = set(ref_params) - set(got)
+        if missing:
+            raise AssertionError(f"worker {p} missing params: {missing}")
+        for k, rv in ref_params.items():
+            if not np.allclose(got[k], rv, rtol=1e-4, atol=1e-5):
+                diff = float(np.max(np.abs(got[k] - rv)))
+                raise AssertionError(
+                    f"worker {p} param {k} diverged from single-process "
+                    f"reference (max abs diff {diff})")
+    print(f"multihost dryrun ok: {num_processes} processes x "
+          f"{devices_per_proc} devices, gradient sync matches "
+          f"single-process (loss {ref_loss:.6f})")

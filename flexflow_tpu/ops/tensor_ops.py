@@ -123,18 +123,31 @@ class Cast(Op):
 @register_op(OperatorType.CONST)
 class Const(Op):
     """Embedded constant tensor (torch.fx get_attr buffers — e.g. a GPT-2
-    causal mask registered as a module buffer). Not trainable; the value
-    is baked into the traced program."""
+    causal mask registered as a module buffer). With ``trainable=True``
+    the value becomes a leaf parameter updated by the optimizer (a bare
+    ``nn.Parameter`` used directly in forward, e.g. a learned positional
+    embedding) instead of being baked into the traced program."""
 
     def __init__(self, layer, input_shapes):
         self.value = np.asarray(layer.get_property("value"))
+        self.trainable = bool(layer.get_property("trainable", False))
         super().__init__(layer, input_shapes)
 
     def compute_output_shapes(self):
         return [tuple(self.value.shape)]
 
+    def init_params(self, rng):
+        if self.trainable:
+            return {"weight": jnp.asarray(self.value)}
+        return {}
+
     def forward(self, params, inputs, ctx: OpContext):
+        if self.trainable:
+            return [params["weight"]]
         return [jnp.asarray(self.value)]
+
+    def params_elems(self):
+        return int(self.value.size) if self.trainable else 0
 
     def output_dim_roles(self):
         return [tuple(DimRole.OTHER for _ in self.value.shape)]

@@ -32,8 +32,9 @@ def _param_shapes(op) -> Dict[str, List[int]]:
 
 def _node_attrs(op) -> Dict[str, Any]:
     attrs = {}
-    for k in ("num_heads", "groups", "axis", "out_dim", "k", "n",
-              "n_experts", "hidden_size", "alpha", "out_channels"):
+    for k in ("num_heads", "num_kv_heads", "groups", "axis", "out_dim",
+              "k", "n", "n_experts", "hidden_size", "alpha",
+              "out_channels"):
         v = getattr(op, k, None)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             attrs[k] = v
@@ -130,6 +131,9 @@ def machine_to_json(spec, num_devices: int,
         # bf16 activations/grads under mixed precision: collectives move
         # half the nominal f32 bytes (ffs_machine.hpp comm_bytes_factor)
         comm_bytes_factor=comm_bytes_factor,
+        # per-slice ICI torus extents — drives the native model's
+        # per-axis ring pricing (ffs_machine.hpp assign_torus)
+        torus=[int(t) for t in getattr(spec, "torus", None) or []],
     )
 
 
@@ -259,6 +263,9 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 config, "enable_pipeline_parallel", True),
             pipeline_microbatches=getattr(
                 config, "pipeline_microbatches", 0),
+            # --disable-fusion: gate the fuse_parallel_ops rewrite family
+            # (kernel fusion itself belongs to XLA)
+            perform_fusion=getattr(config, "perform_fusion", True),
         ),
         measured=measured or {},
     )

@@ -123,6 +123,13 @@ def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
             choice = _infer_choice(node, st)
         assignment[str(node.op.guid)] = choice
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+    if axes.get("pipe", 1) > 1:
+        # the replay request below carries only data/model/seq/expert;
+        # feeding a pipeline-compiled model through it would price the
+        # wrong mesh and make the priced-vs-emitted diff meaningless
+        raise NotImplementedError(
+            "priced_collectives: pipeline strategies (pipe axis > 1) are "
+            "not supported by collective validation yet")
     req = dict(
         nodes=serialize_graph(nodes),
         machine=machine_to_json(ff.machine_spec, ff.mesh.devices.size),

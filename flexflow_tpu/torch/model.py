@@ -157,9 +157,12 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
         t = node.target
         d["target"] = t if isinstance(t, str) else getattr(t, "__name__", str(t))
     elif node.op == "get_attr":
-        # module buffer/parameter referenced directly (e.g. a registered
-        # causal-mask buffer): embed its value as a constant. Reduced
-        # dtypes (bf16/f16/bool) have no numpy/JSON path — store as f32.
+        # module buffer/parameter referenced directly: a registered buffer
+        # (e.g. a causal mask) becomes a baked constant; a bare
+        # nn.Parameter with requires_grad (e.g. a learned positional
+        # embedding used as `x + self.pos`) becomes a TRAINABLE leaf so
+        # training semantics match the source module. Reduced dtypes
+        # (bf16/f16/bool) have no numpy/JSON path — store as f32.
         obj = module
         for part in str(node.target).split("."):
             obj = getattr(obj, part)
@@ -170,6 +173,8 @@ def _node_desc_from_fx(module, node, shapes: Dict[str, Tuple[int, ...]]):
         d["target"] = "get_attr"
         d["value"] = arr.tolist()
         d["value_dtype"] = str(arr.dtype)
+        d["trainable"] = bool(isinstance(obj, torch.nn.Parameter)
+                              and obj.requires_grad)
     elif node.op == "placeholder":
         d["target"] = node.name
         d["shape"] = list(shapes.get(node.name, ()))
@@ -297,7 +302,8 @@ class PyTorchModel:
                                                     "float32"))
                                if d.get("value_dtype") != "bool"
                                else np.float32)
-            return ff.constant(value, name=name)
+            return ff.constant(value, name=name,
+                               trainable=d.get("trainable", False))
         if op == "call_module":
             if target == "Linear":
                 return ff.dense(args[0], cfg["out_features"],
@@ -673,9 +679,10 @@ class PyTorchModel:
         if target in ("masked_fill", "masked_fill_"):
             # fill via a broadcast constant, NOT x*0+value (x may hold inf
             # from a previous mask, and inf*0 = NaN)
+            # scalar constant + Where broadcasting — an activation-shaped
+            # fill would bloat the trace and pin the traced batch size
             x, mask, value = args[0], args[1], float(args[2])
-            fill = ff.constant(np.full(tuple(x.shape), value, np.float32),
-                               name=f"{name}_fill")
+            fill = ff.constant(np.float32(value), name=f"{name}_fill")
             return ff.where(mask, fill, x, name=name)
         if target == "where":
             return ff.where(args[0], args[1], args[2], name=name)
@@ -772,9 +779,7 @@ class PyTorchModel:
                 tri = np.tril(np.ones((q.shape[2], k.shape[2]),
                                       np.float32))
                 mask = ff.constant(tri, name=f"{name}_mask")
-                neg = ff.constant(
-                    np.full(tuple(s.shape), -1e30, np.float32),
-                    name=f"{name}_neg")
+                neg = ff.constant(np.float32(-1e30), name=f"{name}_neg")
                 s = ff.where(mask, s, neg, name=f"{name}_masked")
             p = ff.softmax(s, axis=-1, name=f"{name}_p")
             return ff.einsum("bhqk,bhkd->bhqd", [p, v], name=name)

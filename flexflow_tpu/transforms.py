@@ -109,12 +109,21 @@ def fold_conv_batchnorm(ff) -> int:
                comp_mode=CompMode.INFERENCE,
                machine_spec=ff.machine_spec, mesh=ff.mesh)
 
+    # the recompiled graph is the same graph minus the folded BNs, so
+    # every carried-over parameter must restore cleanly; a failure means
+    # the fold corrupted the graph and the pass's bit-exactness contract
+    # is already broken — surface it instead of training on re-inits
+    failed = []
     for lname, sub in others:
         for pname, value in sub.items():
             try:
                 ff.set_parameter(lname, value, pname)
-            except (KeyError, ValueError):
-                pass  # layer reshaped/absent after recompile
+            except (KeyError, ValueError) as e:
+                failed.append((lname, pname, str(e)))
+    if failed:
+        raise RuntimeError(
+            "fold_conv_batchnorm: failed to restore carried-over weights "
+            f"after recompile: {failed}")
     import jax
     import jax.numpy as jnp
     for lname, sub in state_save.items():

@@ -14,10 +14,15 @@
 #include <cstdint>
 #include <map>
 #include <string>
+#include <vector>
 
 #include "ffs_json.hpp"
 
 namespace ffsearch {
+
+// Logical mesh-axis ids for per-axis torus pricing. Values match the
+// Spec axis constants in ffs_strategy.hpp (kData..kExpert).
+enum : int8_t { AX_DATA = 0, AX_MODEL = 1, AX_SEQ = 2, AX_EXPERT = 3 };
 
 struct MachineModel {
   int num_devices = 1;
@@ -38,6 +43,92 @@ struct MachineModel {
   // runs f32/CPU where this stays 1.0).
   double comm_bytes_factor = 1.0;
 
+  // ICI torus extents of ONE slice (e.g. [4, 2] for a v5e-8, [4, 4, 4]
+  // for a v4-128 cube). Replaces the reference's Enhanced/Networked
+  // machine-model link graphs (simulator.h:229-515) with the structure
+  // TPU hardware actually has. Empty = flat (every axis prices alike).
+  std::vector<int64_t> torus;
+  // Per-logical-axis multipliers from embedding the CURRENT mesh into
+  // the torus (assign_torus): a mesh axis mapped to a full torus dim
+  // keeps the wrapped-ring bandwidth (1.0); a sub-ring of a dim is a
+  // line without wraparound (0.5); a fragmented axis also pays hops.
+  double ax_bw[4] = {1.0, 1.0, 1.0, 1.0};
+  double ax_lat[4] = {1.0, 1.0, 1.0, 1.0};
+
+  double axbw(int8_t a) const {
+    return (a >= 0 && a < 4) ? ax_bw[(int)a] : 1.0;
+  }
+  double axlat(int8_t a) const {
+    return (a >= 0 && a < 4) ? ax_lat[(int)a] : 1.0;
+  }
+
+  // Embed a (dp, mp, sp, ep) mesh into the slice torus and set the
+  // per-axis multipliers. Latency/bandwidth-critical axes get first
+  // pick of the torus dims: the per-layer psum (model), then the
+  // attention K/V ring (seq), then the MoE exchange (expert); the
+  // gradient ring (data) overlaps with backward and takes the rest.
+  void assign_torus(int dp, int mp, int sp, int ep) {
+    for (int i = 0; i < 4; ++i) {
+      ax_bw[i] = 1.0;
+      ax_lat[i] = 1.0;
+    }
+    if (torus.size() < 2) return;  // flat or 1-D: nothing to distinguish
+    int64_t tprod = 1;
+    for (int64_t t : torus) tprod *= t;
+    if (tprod != (int64_t)chips_per_slice()) return;  // stale description
+    std::vector<int64_t> cap(torus.begin(), torus.end());
+    auto place = [&](int8_t a, int64_t k) {
+      if (k <= 1) return;
+      // exact full dim: wrapped ring at full per-dim bandwidth
+      for (size_t i = 0; i < cap.size(); ++i)
+        if (cap[i] == torus[i] && torus[i] == k) {
+          cap[i] = 1;
+          return;
+        }
+      // exact product of two untouched dims: the ring embeds across
+      // both with wraparound (Hamiltonian cycle on the sub-torus)
+      for (size_t i = 0; i < cap.size(); ++i)
+        for (size_t j = i + 1; j < cap.size(); ++j)
+          if (cap[i] == torus[i] && cap[j] == torus[j] &&
+              torus[i] * torus[j] == k) {
+            cap[i] = cap[j] = 1;
+            return;
+          }
+      // exact product of ALL untouched dims (e.g. 8 on a 2x2x2 cube)
+      {
+        int64_t prod = 1;
+        for (size_t i = 0; i < cap.size(); ++i)
+          prod *= (cap[i] == torus[i]) ? torus[i] : 1;
+        if (prod == k) {
+          for (size_t i = 0; i < cap.size(); ++i)
+            if (cap[i] == torus[i]) cap[i] = 1;
+          return;
+        }
+      }
+      // sub-ring of one dim: a line, no wraparound link — half bw
+      for (size_t i = 0; i < cap.size(); ++i)
+        if (cap[i] >= k && cap[i] % k == 0) {
+          cap[i] /= k;
+          ax_bw[(int)a] = 0.5;
+          return;
+        }
+      // fragmented across dims: half bandwidth and doubled hop count
+      ax_bw[(int)a] = 0.5;
+      ax_lat[(int)a] = 2.0;
+    };
+    place(AX_MODEL, mp);
+    place(AX_SEQ, sp);
+    place(AX_EXPERT, ep);
+    if (dp > 1) {
+      int64_t rem = 1;
+      for (int64_t c : cap) rem *= c;
+      // data axis consuming ALL remaining intra-slice chips rides every
+      // leftover link (+ DCN across slices, priced by hier_allreduce)
+      if (!((int64_t)dp == rem || (rem > 1 && dp % rem == 0)))
+        place(AX_DATA, dp);
+    }
+  }
+
   static MachineModel from_json(const Json& j) {
     MachineModel m;
     m.num_devices = static_cast<int>(j.get("num_devices").as_int(1));
@@ -53,6 +144,9 @@ struct MachineModel {
     m.min_op_time = j.get("min_op_time").as_double(m.min_op_time);
     m.comm_bytes_factor =
         j.get("comm_bytes_factor").as_double(m.comm_bytes_factor);
+    const Json& tj = j.get("torus");
+    if (!tj.is_null())
+      for (const Json& t : tj.items()) m.torus.push_back(t.as_int(1));
     return m;
   }
 
@@ -60,39 +154,45 @@ struct MachineModel {
   double ring_bw() const { return ici_bw * 2.0; }
 
   // Ring all-reduce of `bytes` over `k` chips: 2(k-1)/k * B / bw.
-  double allreduce_time(double bytes, int k) const {
+  // `axis` selects the per-axis torus multipliers (AX_*, -1 = neutral).
+  double allreduce_time(double bytes, int k, int8_t axis = -1) const {
     bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
-    return ici_latency * (k - 1) + 2.0 * (k - 1) / k * bytes / ring_bw();
+    return ici_latency * axlat(axis) * (k - 1) +
+           2.0 * (k - 1) / k * bytes / (ring_bw() * axbw(axis));
   }
 
   // All-gather producing `bytes` full output on each of `k` chips.
-  double allgather_time(double bytes, int k) const {
+  double allgather_time(double bytes, int k, int8_t axis = -1) const {
     bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
-    return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
+    return ici_latency * axlat(axis) * (k - 1) +
+           (double)(k - 1) / k * bytes / (ring_bw() * axbw(axis));
   }
 
   // Reduce-scatter of `bytes` over `k` chips.
-  double reducescatter_time(double bytes, int k) const {
+  double reducescatter_time(double bytes, int k, int8_t axis = -1) const {
     bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
-    return ici_latency * (k - 1) + (double)(k - 1) / k * bytes / ring_bw();
+    return ici_latency * axlat(axis) * (k - 1) +
+           (double)(k - 1) / k * bytes / (ring_bw() * axbw(axis));
   }
 
   // One full ring rotation (ring attention K/V pass): `bytes` total sent
   // per chip over k-1 neighbor hops on one ICI link direction.
-  double ring_time(double bytes, int k) const {
+  double ring_time(double bytes, int k, int8_t axis = -1) const {
     bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
-    return ici_latency * (k - 1) + bytes / ici_bw;
+    return ici_latency * axlat(axis) * (k - 1) +
+           bytes / (ici_bw * axbw(axis));
   }
 
   // All-to-all: each chip exchanges its (bytes/k) shard with k-1 peers.
-  double alltoall_time(double bytes, int k) const {
+  double alltoall_time(double bytes, int k, int8_t axis = -1) const {
     bytes *= comm_bytes_factor;
     if (k <= 1 || bytes <= 0) return 0.0;
-    return ici_latency + bytes * (k - 1) / k / k / ring_bw();
+    return ici_latency * axlat(axis) +
+           bytes * (k - 1) / k / k / (ring_bw() * axbw(axis));
   }
 
   // Cross-slice (DCN) all-reduce of `bytes` across num_slices.
@@ -112,14 +212,15 @@ struct MachineModel {
   // cross-slice all-reduce of each chip's 1/k_inner shard over DCN — the
   // standard multislice gradient sync (NetworkedMachineModel's role,
   // reference simulator.h:515, re-expressed for the TPU slice topology).
-  double hier_allreduce_time(double bytes, int k, int slices) const {
+  double hier_allreduce_time(double bytes, int k, int slices,
+                             int8_t axis = -1) const {
     // NOTE: delegates to allreduce_time, which applies comm_bytes_factor —
     // only the DCN term scales locally (no double scaling)
     if (k <= 1 || bytes <= 0) return 0.0;
     slices = std::max(1, std::min(slices, num_slices));
-    if (slices <= 1) return allreduce_time(bytes, k);
+    if (slices <= 1) return allreduce_time(bytes, k, axis);
     int k_inner = std::max(1, k / slices);
-    double t = allreduce_time(bytes, k_inner);
+    double t = allreduce_time(bytes, k_inner, axis);
     double shard = bytes * comm_bytes_factor / k_inner;
     t += dcn_latency * (slices - 1) +
          2.0 * (slices - 1) / slices * shard / dcn_bw;

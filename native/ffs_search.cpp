@@ -60,6 +60,8 @@ struct SearchConfig {
   bool enable_pipeline_parallel = true;  // GPipe over a 'pipe' axis (r4)
   int pipeline_microbatches = 0;    // 0 = auto (search over {1,2,4,8}*pp)
   int subst_budget = 0;             // best-first expansions (0 = from budget)
+  bool perform_fusion = true;       // fuse_parallel_ops rule family
+                                    // (reference --disable-fusion)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
   static SearchConfig from_json(const Json& j) {
@@ -79,8 +81,11 @@ struct SearchConfig {
     c.enable_sample_parallel = j.get("enable_sample_parallel").as_bool(true);
     c.enable_pipeline_parallel = j.get("enable_pipeline_parallel").as_bool(true);
     c.pipeline_microbatches = (int)j.get("pipeline_microbatches").as_int(0);
+    // best-first expansions scale with the user's budget (r5; the old
+    // min(budget,16) cap could not exploit a 640-rule corpus)
     c.subst_budget = (int)j.get("subst_budget").as_int(
-        std::max(1, std::min(c.budget, 16)));
+        std::max(1, std::min(4 * c.budget, 256)));
+    c.perform_fusion = j.get("perform_fusion").as_bool(true);
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
       for (const Json& a : r.get("allow").items()) names.push_back(a.as_string());
@@ -481,14 +486,20 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
                      MCMCStats* mcmc, const PipelineMeta& pipe = {}) {
   GraphEval ev;
   for (const MeshShape& mesh : enumerate_meshes(g, m, cfg, pipe)) {
+    // per-axis torus pricing: embed THIS mesh's axes into the slice
+    // torus so an axis mapped to a full torus dim prices a wrapped
+    // ring while a sub-ring/fragmented mapping pays line penalties
+    // (EnhancedMachineModel role, reference simulator.h:229-279)
+    MachineModel mt = m;
+    mt.assign_torus(mesh.dp, mesh.mp, mesh.sp, mesh.ep);
     auto choices = all_choices(g, mesh, cfg);
     // pp>1: the DP's memory model has no pipe axis (it would see every
     // chip holding all blocks and prune exactly the configs pipelining
     // exists to fit) — run unconstrained and let simulate_pipeline's
     // 1/pp-aware memory check enforce the threshold
     DPResult dp = mesh.pp > 1
-        ? frontier_dp(g, choices, mesh, m, cfg, 0.0, &measured)
-        : dp_with_memory(g, choices, mesh, m, cfg, threshold, &measured);
+        ? frontier_dp(g, choices, mesh, mt, cfg, 0.0, &measured)
+        : dp_with_memory(g, choices, mesh, mt, cfg, threshold, &measured);
     ev.states += dp.states;
     if (!dp.ok) continue;
     std::vector<Choice> cs0;
@@ -508,7 +519,7 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
         if (M < 1) continue;
         int64_t b = cfg.batch > 0 ? cfg.batch : pipe.batch;
         if (b > 0 && (b % ((int64_t)M * std::max(1, mesh.dp)))) continue;
-        SimResult sr = simulate_pipeline(g, m, mesh, cs0, pipe, cfg.training,
+        SimResult sr = simulate_pipeline(g, mt, mesh, cs0, pipe, cfg.training,
                                          cfg.opt_state_factor, &measured, M);
         if (threshold > 0 && sr.memory > threshold) continue;
         if (sr.iteration_time < ev.time) {
@@ -523,11 +534,11 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
       }
       continue;
     }
-    TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
+    TaskgraphSimulator sim(g, mt, mesh, cfg.training, cfg.overlap,
                            cfg.opt_state_factor, &measured);
     Assignment a = dp.assign;
     if (refine && cfg.budget > 0 && mcmc != nullptr)
-      a = mcmc_refine(g, choices, mesh, m, cfg, sim, a, threshold, mcmc);
+      a = mcmc_refine(g, choices, mesh, mt, cfg, sim, a, threshold, mcmc);
     std::vector<Choice> cs;
     for (size_t i = 0; i < a.size(); ++i) cs.push_back(choices[i][a[i]]);
     SimResult sr = sim.simulate(cs);
@@ -603,6 +614,15 @@ Json optimize(const Json& req) {
       rules.erase(std::remove_if(rules.begin(), rules.end(),
                                  [](const SubstRule& r) {
                                    return r.inference_only;
+                                 }),
+                  rules.end());
+    if (!cfg.perform_fusion)
+      // --disable-fusion: drop the fuse_parallel_ops family (the only
+      // explicit-fusion rewrites; kernel fusion itself belongs to XLA)
+      rules.erase(std::remove_if(rules.begin(), rules.end(),
+                                 [](const SubstRule& r) {
+                                   return r.name.find("fuse_parallel_ops")
+                                          != std::string::npos;
                                  }),
                   rules.end());
   }
@@ -777,6 +797,7 @@ Json simulate_only(const Json& req) {
                  (int)req.get("mesh").get("model").as_int(1),
                  (int)req.get("mesh").get("seq").as_int(1),
                  (int)req.get("mesh").get("expert").as_int(1)};
+  m.assign_torus(mesh.dp, mesh.mp, mesh.sp, mesh.ep);
   auto choices = all_choices(g, mesh, cfg);
   std::vector<Choice> cs;
   const Json& sel = req.get("assignment");

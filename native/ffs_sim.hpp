@@ -109,7 +109,7 @@ class TaskgraphSimulator {
       fwd_id[i] = add(std::move(ft));
       res.fwd_time += nc.fwd;
       if (c.psum_bytes > 0 && c.psum_k > 1) {
-        double t = m_.allreduce_time(c.psum_bytes, c.psum_k);
+        double t = m_.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
         SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
                    "allreduce", c.psum_bytes};
         fwd_id[i] = add(std::move(ct));  // consumers wait on the psum
@@ -117,7 +117,7 @@ class TaskgraphSimulator {
       }
       if (c.ring_bytes > 0 && c.ring_k > 1) {
         // ring-attention K/V rotation (seq axis): runs on the ICI stream
-        double t = m_.ring_time(c.ring_bytes, c.ring_k);
+        double t = m_.ring_time(c.ring_bytes, c.ring_k, kSeq);
         SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
                    "ppermute", c.ring_bytes};
         fwd_id[i] = add(std::move(ct));
@@ -125,7 +125,7 @@ class TaskgraphSimulator {
       }
       if (c.gather_bytes > 0 && c.gather_k > 1) {
         // all-gather a Combine boundary forces
-        double t = m_.allgather_time(c.gather_bytes, c.gather_k);
+        double t = m_.allgather_time(c.gather_bytes, c.gather_k, c.gather_axis);
         SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
                    "allgather", c.gather_bytes};
         fwd_id[i] = add(std::move(ct));
@@ -172,11 +172,11 @@ class TaskgraphSimulator {
         double bwd_comm_bytes = 0;
         double dur = nc.bwd;
         if (c.psum_k > 1 && c.psum_bytes > 0) {
-          dur += m_.allreduce_time(c.psum_bytes, c.psum_k);
+          dur += m_.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
           bwd_comm_bytes += c.psum_bytes;
         }
         if (c.ring_bytes > 0 && c.ring_k > 1)  // bwd rotates K/V and dK/dV
-          dur += 2.0 * m_.ring_time(c.ring_bytes, c.ring_k);
+          dur += 2.0 * m_.ring_time(c.ring_bytes, c.ring_k, kSeq);
         SimTask bt{SimTask::Kind::Bwd, i, dur, deps,
                    bwd_comm_bytes > 0 ? "allreduce" : "", bwd_comm_bytes};
         bwd_id[i] = add(std::move(bt));
@@ -196,7 +196,7 @@ class TaskgraphSimulator {
         const Choice& c = assign[i];
         if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {
           double t = m_.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                            spans);
+                                            spans, kData);
           std::vector<int> deps = {bwd_id[i]};
           if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
           SimTask st{SimTask::Kind::GradSync, (int)i, t, deps,
@@ -329,7 +329,7 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
       if (c.gradsync_bytes > 0 && c.gradsync_k > 1)
         head_tail_gradsync +=
             m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                  slices_spanned(inner, m));
+                                  slices_spanned(inner, m), kData);
     }
   }
   const double ticks = M + pp - 1;
@@ -338,10 +338,19 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   double op_floor = (double)body_ops / pp * m.min_op_time;
   double tick_fwd = std::max(fwd_body / (pp * M), op_floor);
   double tick_bwd = std::max(bwd_body / (pp * M), op_floor);
-  // activation hop: boundary tensor / (M * dp) per microbatch shard
+  // activation hop: boundary tensor / (M * dp) per microbatch shard.
+  // Each tick, every stage forwards simultaneously, so the tick's hop
+  // cost is the slowest hop: if the pipeline's chip range extends past
+  // one slice, at least one stage boundary crosses DCN, and that hop
+  // gates the tick — price all ticks' hops at DCN in that case
+  // (enumerate_meshes allows pipe stages to span slices).
   double hop_bytes = meta.block_out_bytes * m.comm_bytes_factor /
                      ((double)M * mesh.dp);
-  double hop = m.ici_latency + hop_bytes / m.ici_bw;
+  int inner_chips = mesh.dp * mesh.mp * mesh.sp * mesh.ep;
+  bool spans_slices =
+      m.num_slices > 1 && inner_chips * pp > m.chips_per_slice();
+  double hop = spans_slices ? (m.dcn_latency + hop_bytes / m.dcn_bw)
+                            : (m.ici_latency + hop_bytes / m.ici_bw);
   res.fwd_time = ticks * (tick_fwd + hop) + fwd_edge;
   res.comm_time = ticks * hop * (training ? 2.0 : 1.0) + fwd_edge;
   // fwd_edge (per-op collectives of body choices) charges iteration_time
@@ -353,7 +362,8 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
     if (mesh.dp > 1 && body_gradsync_bytes > 0)
       res.gradsync_time = m.hier_allreduce_time(body_gradsync_bytes / pp,
                                                 gradsync_k,
-                                                slices_spanned(inner, m));
+                                                slices_spanned(inner, m),
+                                                kData);
     res.gradsync_time += head_tail_gradsync;
     res.iteration_time += res.gradsync_time;
     double upd_bw = m.hbm_bw;

@@ -97,6 +97,8 @@ struct Choice {
   double work_div = 1.0;               // compute FLOPs divided by this
   double psum_bytes = 0.0;             // partial-sum bytes reduced over model axis
   int psum_k = 1;
+  int8_t psum_axis = kModel;           // mesh axis the psum rides (torus pricing)
+  int8_t gather_axis = kModel;         // mesh axis a Combine gathers over
   double gradsync_bytes = 0.0;         // per-iteration gradient allreduce bytes
   int gradsync_k = 1;                  // chips in the gradient ring (dp * sp)
   double ring_bytes = 0.0;             // K/V bytes a device sends over a full
@@ -342,28 +344,51 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     }
   } else if (t == "MULTIHEAD_ATTENTION" && pp) {
     int64_t heads = n.attrs.get("num_heads").as_int(0);
+    int64_t kv_heads = n.attrs.get("num_kv_heads").as_int(heads);
+    if (kv_heads <= 0) kv_heads = heads;
     if (heads > 0 && div_ok(heads, mp)) {
       // attribute parallelism: shard the head axis of every weight whose
-      // dim 0 == num_heads (wq/wk/wv [H,E,D], wo [H,D,E]) — the reference's
-      // create_partition_attention_combine (substitution.cc:1764)
+      // dim 0 == num_heads (wq [H,E,D], wo [H,D,E]) — the reference's
+      // create_partition_attention_combine (substitution.cc:1764). Under
+      // GQA (attention.cc:214 head-count split) wk/wv carry num_kv_heads
+      // on dim 0: shard them too when kv_heads divides mp; otherwise they
+      // stay replicated and their gradient ring spans ALL dp*mp chips —
+      // priced separately so the search sees the true GQA cost.
       int eff_dp = dp_legal ? dp : 1;
       Choice c = dp_legal ? make_dp() : base_choice("head");
       c.name = dp_legal ? "dp_head" : "head";
       bool any = false;
+      bool kv_sharded = div_ok(kv_heads, mp);
+      double sharded_bytes = 0.0, replicated_bytes = 0.0;
       for (const auto& kv : n.params) {
-        if (!kv.second.empty() && kv.second[0] == heads) {
+        int64_t dim0 = kv.second.empty() ? 0 : kv.second[0];
+        double bytes = (double)shape_elems(kv.second) * n.dtype_size;
+        if (dim0 == heads || (dim0 == kv_heads && kv_sharded)) {
           Spec s = rep_spec(kv.second.size());
           s[0] = kModel;
           c.param[kv.first] = s;
+          sharded_bytes += bytes;
           any = true;
+        } else {
+          replicated_bytes += bytes;
         }
       }
       if (any) {
         c.psum_bytes = (double)n.output_bytes(0) / eff_dp;  // output proj psum
         c.psum_k = mp;
         c.work_div = static_cast<double>(eff_dp) * mp;
-        c.gradsync_bytes = detail::pbytes(n) / mp;
-        c.gradsync_k = eff_dp;
+        // head-sharded params ring over dp; replicated (kv) params ring
+        // over every chip — fold both into one equivalent-bytes ring
+        // (a ring of k chips moves ~2B/bw per chip regardless of k, so
+        // payload, not ring size, dominates)
+        if (eff_dp > 1) {
+          c.gradsync_bytes = sharded_bytes / mp + replicated_bytes;
+          c.gradsync_k = eff_dp;
+        } else if (replicated_bytes > 0) {
+          // pure TP: replicated kv grads still allreduce over mp
+          c.gradsync_bytes = replicated_bytes;
+          c.gradsync_k = mp;
+        }
         out.push_back(std::move(c));
       }
     }
@@ -388,9 +413,11 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.in[0][dim] = ax;         // consumes the sharded layout...
         c.gather_bytes = (double)n.output_bytes(0);  // ...and gathers it
         c.gather_k = (int)deg;
+        c.gather_axis = ax;
       } else if (t == "REDUCTION") {
         c.psum_bytes = (double)n.output_bytes(0);
         c.psum_k = (int)deg;
+        c.psum_axis = ax;
       }
       // REPLICATE: in/out replicated — the reshard from a sharded producer
       // is the broadcast cost, charged on the input edge
@@ -470,6 +497,7 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.psum_bytes = alpha_cap * kk * (double)b_tokens * d_model * 4.0 /
                        eff_dp;
         c.psum_k = ep;
+        c.psum_axis = kExpert;
         c.gradsync_bytes = detail::pbytes(n) / ep;
         c.gradsync_k = eff_dp;
         out.push_back(std::move(c));
@@ -608,21 +636,21 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     nc.bwd = mbwd ? std::max(*mbwd / div, m.min_op_time)
                   : 2.0 * nc.fwd;  // dX + dW passes
   if (c.psum_bytes > 0 && c.psum_k > 1) {
-    double t = m.allreduce_time(c.psum_bytes, c.psum_k);
+    double t = m.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
   }
   if (c.ring_bytes > 0 && c.ring_k > 1) {
     // ring attention K/V rotation; the backward rotates K/V and dK/dV
-    double t = m.ring_time(c.ring_bytes, c.ring_k);
+    double t = m.ring_time(c.ring_bytes, c.ring_k, kSeq);
     nc.comm += training ? 3.0 * t : t;
   }
   if (c.gather_bytes > 0 && c.gather_k > 1) {
-    double t = m.allgather_time(c.gather_bytes, c.gather_k);
+    double t = m.allgather_time(c.gather_bytes, c.gather_k, c.gather_axis);
     nc.comm += training ? 2.0 * t : t;  // bwd scatters the gradient back
   }
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
     nc.gradsync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                        slices_spanned(mesh, m));
+                                        slices_spanned(mesh, m), kData);
   return nc;
 }
 

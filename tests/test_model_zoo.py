@@ -33,6 +33,7 @@ def one_step(ff, xs, y, loss=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
 
 
 class TestVisionModels:
+    @pytest.mark.slow
     def test_resnet_small(self):
         cfg = ResNetConfig(batch_size=2, image_size=64, stages=(1, 1, 1, 1))
         ff = create_resnet(cfg)
@@ -40,6 +41,7 @@ class TestVisionModels:
         y = RS.randint(0, 10, (2, 1)).astype(np.int32)
         one_step(ff, x, y)
 
+    @pytest.mark.slow
     def test_resnext_small(self):
         cfg = ResNeXtConfig(batch_size=2, image_size=64, stages=(1, 1, 1, 1),
                             cardinality=8)
@@ -48,6 +50,7 @@ class TestVisionModels:
         y = RS.randint(0, 1000, (2, 1)).astype(np.int32)
         one_step(ff, x, y)
 
+    @pytest.mark.slow
     def test_inception_small(self):
         cfg = InceptionConfig(batch_size=2, image_size=75, num_classes=10)
         ff = create_inception_v3(cfg)

@@ -1,0 +1,47 @@
+"""Multi-host (multi-controller) execution path.
+
+Reference analog: tests/multinode_helpers/mpi_wrapper1.sh (mpirun -np 2
+with per-rank GPU masks). Here: 2 subprocesses x 2 virtual CPU devices,
+jax.distributed rendezvous with gloo collectives, per-process batch
+staging via jax.make_array_from_process_local_data — gradient sync must
+reproduce the single-process run bit-for-bit up to reduction order.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu import distributed
+from flexflow_tpu.machine import make_mesh
+
+
+class TestLocalBatchRows:
+    def test_single_process_is_identity(self):
+        mesh = make_mesh(8, {"data": 8})
+        sh = NamedSharding(mesh, P("data"))
+        assert distributed.local_batch_rows(sh, 16) == (16, 0)
+
+    def test_batch_partitions(self):
+        mesh = make_mesh(8, {"data": 4, "model": 2})
+        assert distributed._batch_partitions(
+            NamedSharding(mesh, P("data"))) == 4
+        assert distributed._batch_partitions(NamedSharding(mesh, P())) == 1
+
+    def test_stage_local_single_process(self):
+        mesh = make_mesh(8, {"data": 8})
+        sh = NamedSharding(mesh, P("data"))
+        arr = np.arange(32, dtype=np.float32).reshape(16, 2)
+        out = distributed.stage_local_batch(arr, sh)
+        assert out.shape == (16, 2)
+        np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+@pytest.mark.slow
+class TestMultiProcess:
+    def test_two_process_gradient_sync_matches_single(self):
+        """2 procs x 2 virtual devices each == one 4-device process."""
+        from flexflow_tpu.multihost_dryrun import run_dryrun
+
+        run_dryrun(num_processes=2, devices_per_proc=2)

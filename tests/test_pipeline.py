@@ -247,6 +247,7 @@ class TestPipelineLowering:
     (VERDICT r3 Next #1: pipeline as a framework capability, not a
     library demo)."""
 
+    @pytest.mark.slow
     def test_explicit_pipe_mesh_matches_single_device(self):
         from flexflow_tpu.parallel.pipeline_exec import (
             BODY_KEY, PipelineGraphExecutor)
@@ -278,6 +279,7 @@ class TestPipelineLowering:
                                    ff_ref.get_parameter("ffn1_2"),
                                    rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.slow
     def test_search_picks_pipe_and_executes(self):
         """Deep-narrow transformer on the 8-device mesh: the search must
         DISCOVER a pipe>1 mesh and the compiled model must train."""
@@ -296,6 +298,7 @@ class TestPipelineLowering:
         l1 = ff.evaluate(x, y)["loss"]
         assert np.isfinite(l1) and l1 < l0
 
+    @pytest.mark.slow
     def test_checkpoint_roundtrip_with_stacked_body(self, tmp_path):
         rs = np.random.RandomState(0)
         x = rs.randn(16, 32, 64).astype(np.float32)
@@ -316,6 +319,7 @@ class TestPipelineLowering:
 class TestPipelineSearchCostModel:
     """Native GPipe cost model (simulated v4-32, deviceless)."""
 
+    @pytest.mark.slow
     def test_pipe_beats_dp_tp_on_deep_narrow(self):
         from flexflow_tpu.machine import MachineSpec
         from flexflow_tpu.search.native import available, native_optimize

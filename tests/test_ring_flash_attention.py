@@ -43,6 +43,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_flow(self):
         mesh = make_mesh(8, {"data": 2, "seq": 4})
         q, k, v = qkv()
@@ -201,6 +202,7 @@ class TestRingFlashInner:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_flash_inner_gradients(self):
         mesh = make_mesh(8, {"seq": 8})
         q, k, v = qkv(b=1, h=2, s=1024, d=8)
@@ -219,6 +221,7 @@ class TestRingFlashInner:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-3, atol=5e-4)
 
+    @pytest.mark.slow
     def test_flash_lse_primitive(self):
         """flash_attention_lse's lse output and its gradient path."""
         from flexflow_tpu.ops.pallas_kernels import flash_attention_lse

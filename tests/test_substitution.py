@@ -658,3 +658,22 @@ class TestComputeRewriteFamilies:
         y = rs.randn(32, 16).astype(np.float32)
         ff.fit(x, y, epochs=1, verbose=False)
         assert np.isfinite(ff.predict(x)).all()
+
+    def test_disable_fusion_gates_fuse_parallel_ops(self):
+        """--disable-fusion (perform_fusion=False) must drop the
+        fuse_parallel_ops rewrite family and nothing else."""
+        b, d = 2048, 1024
+        nodes = [
+            _linear(1, "fc", [-2, 0], b, d, d),
+            _node(2, "COMBINE", "comb", [[1, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _node(3, "REPLICATE", "repl", [[2, 0]], [[b, d]], [[b, d]],
+                  attrs={"degree": 2}),
+            _linear(4, "fc2", [3, 0], b, d, d),
+        ]
+        base = {"machine": MACHINE, "measured": {}, "nodes": nodes,
+                "final": [4, 0]}
+        resp = native_optimize(
+            dict(base, config=dict(_cfg(budget=3), perform_fusion=False)))
+        rules = [r["rule"] for r in resp.get("rewrites", [])]
+        assert not any("fuse_parallel_ops" in r for r in rules), rules
