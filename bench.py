@@ -1,16 +1,21 @@
 #!/usr/bin/env python
-"""Headline benchmark: BERT-proxy transformer training throughput.
+"""Benchmark zoo: training throughput on the chip for 4 workload families.
 
-Protocol follows the reference's OSDI'22 AE BERT benchmark
+Headline metric follows the reference's OSDI'22 AE BERT benchmark
 (scripts/osdi22ae/bert.sh + examples/cpp/Transformer/transformer.cc:79-84):
 12 layers, hidden 1024, 16 heads, seq 512, batch 8 per chip; metric is
-training samples/s (fwd+bwd+update, jitted). Prints ONE JSON line.
+training samples/s (fwd+bwd+update, jitted). The other three mirror the
+rest of the AE protocol on one chip (scripts/osdi22ae/{inception,dlrm}.sh
++ examples/cpp/mixture_of_experts): a conv family, an embedding-heavy
+recsys model, and a MoE — so executor changes can't regress a family
+unnoticed (VERDICT r4 Missing #2). Prints ONE JSON line.
 
 vs_baseline: ratio against the recorded best from previous rounds
-(bench_history.json), 1.0 on first run — the reference repo publishes no
-absolute numbers (BASELINE.md).
+(bench_history.json, keyed per workload), 1.0 on first run — the
+reference repo publishes no absolute numbers (BASELINE.md).
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -18,43 +23,24 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-def main():
-    import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from flexflow_tpu.config import FFConfig
-    from flexflow_tpu.ffconst import LossType, MetricsType
-    from flexflow_tpu.models.transformer import TransformerConfig, create_transformer
-    from flexflow_tpu.optimizers import SGDOptimizer
+def time_train(ff, xs, y, iters, windows):
+    """Steady-state training samples/s: jitted fwd+bwd+update loop.
 
-    on_cpu = jax.devices()[0].platform == "cpu"
-    cfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
-                             seq_length=64, batch_size=8)
-           if on_cpu else TransformerConfig())  # reference config on TPU
-
-    from flexflow_tpu.optimizers import AdamOptimizer
-
-    # TPU-native optimizer configuration: bf16 m/v storage (update math is
-    # f32 — optimizers.py). The update phase is HBM-bound (measured r4,
-    # scripts/measure_bw.py: ~620 GB/s marginal, so bytes are the lever);
-    # bf16 state cuts its traffic 29%. Convergence parity with f32 state is
-    # asserted by tests/test_model_training.py::test_adam_bf16_state.
-    import jax.numpy as jnp
-    ff = create_transformer(cfg, FFConfig(batch_size=cfg.batch_size))
-    ff.compile(AdamOptimizer(alpha=1e-4, state_dtype=jnp.bfloat16),
-               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
-               [MetricsType.MEAN_SQUARED_ERROR])
-
-    rs = np.random.RandomState(0)
-    x = rs.randn(cfg.batch_size, cfg.seq_length, cfg.hidden_size).astype(np.float32)
-    y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+    Plain per-step dispatch, NOT lax.scan — measured r3 (30 iters, v5e):
+    async dispatch pipelines better than the fused scan (160.35 vs
+    156.46 samples/s), so the plain loop is both the honest protocol and
+    the faster one. float(loss) forces a device->host sync — on the
+    tunneled TPU backend block_until_ready alone does not. Best-of-N
+    windows because the tunnel occasionally stalls for hundreds of ms.
+    """
+    import jax.random as jrandom
 
     train_step = ff.executor.make_train_step()
-    inputs = ff._stage_inputs([x])
+    inputs = ff._stage_inputs(xs)
     labels = ff._shard_batch(y)
-
-    import jax.random as jrandom
 
     def step(params, opt_state, state, rng):
         rng, sub = jrandom.split(rng)
@@ -64,90 +50,224 @@ def main():
 
     params, opt_state, state = ff.params, ff.opt_state, ff.state
     rng = jrandom.PRNGKey(0)
-    # warmup (compile; a second round catches the donation-aliased
-    # recompile); float() forces a real device->host sync — on the
-    # tunneled TPU backend block_until_ready alone does not. Measured
-    # (r3, 30 iters, v5e): plain loop 160.35 samples/s vs
-    # make_multi_step lax.scan 156.46 — async per-step dispatch pipelines
-    # better than the fused scan (scan serializes the donation chain), so
-    # the plain loop is both the honest protocol and the faster one.
+    # warmup (compile; a second round catches the donation-aliased recompile)
     for _ in range(3):
-        params, opt_state, state, rng, loss = step(params, opt_state, state, rng)
+        params, opt_state, state, rng, loss = step(params, opt_state,
+                                                   state, rng)
     float(loss)
-
-    # best of 3 full-length windows: the tunneled backend occasionally
-    # stalls for hundreds of ms (observed: a 20x-slow outlier window on an
-    # otherwise healthy chip), and steady-state throughput is the quantity
-    # of interest. Window length stays at the r1/r2 protocol's 30 steps —
-    # shorter windows under-report by amortizing the per-window host sync
-    # over too few steps.
-    iters = 10 if on_cpu else 30
-    windows = 1 if on_cpu else 3
+    bs = ff.input_tensors[0].shape[0]
     best_dt = None
     final_loss = None
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(iters):
-            params, opt_state, state, rng, loss = step(
-                params, opt_state, state, rng)
+            params, opt_state, state, rng, loss = step(params, opt_state,
+                                                       state, rng)
         final_loss = float(loss)  # sync: depends on the whole step chain
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
     assert np.isfinite(final_loss), f"training diverged: loss={final_loss}"
-    samples_per_s = cfg.batch_size * iters / best_dt
+    return bs * iters / best_dt
 
-    # ---- ratchet: best-ever per workload key --------------------------
-    # The key is protocol name + platform ONLY — never the config dict.
-    # (Round 2 masked a regression because a new config field invalidated
-    # the recorded baseline; a schema change must not reset the ratchet.)
-    workload = f"bert_proxy:{'cpu' if on_cpu else 'tpu'}"
-    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_history.json")
+
+# ---------------------------------------------------------------------------
+# workload builders: name -> (ff, xs, y, config_dict)
+
+
+def build_bert_proxy(on_cpu):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType, MetricsType
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 create_transformer)
+    from flexflow_tpu.optimizers import AdamOptimizer
+
+    cfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                             seq_length=64, batch_size=8)
+           if on_cpu else TransformerConfig())  # reference config on TPU
+    # TPU-native optimizer configuration: bf16 m/v storage (update math is
+    # f32 — optimizers.py). The update phase is HBM-bound (measured r4,
+    # scripts/measure_bw.py: ~620 GB/s marginal, so bytes are the lever);
+    # bf16 state cuts its traffic 29%. Convergence parity with f32 state is
+    # asserted by tests/test_model_training.py::test_adam_bf16_state.
+    ff = create_transformer(cfg, FFConfig(batch_size=cfg.batch_size))
+    ff.compile(AdamOptimizer(alpha=1e-4, state_dtype=jnp.bfloat16),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.MEAN_SQUARED_ERROR])
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, cfg.seq_length,
+                 cfg.hidden_size).astype(np.float32)
+    y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+    return ff, [x], y, dataclasses.asdict(cfg)
+
+
+def build_inception_proxy(on_cpu):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.models.inception import (InceptionConfig,
+                                               create_inception_v3)
+    from flexflow_tpu.optimizers import AdamOptimizer
+
+    # reference AE: batch 64 across 4 GPUs (scripts/osdi22ae/inception.sh);
+    # one-chip proxy keeps the full v3 topology at batch 16
+    cfg = (InceptionConfig(batch_size=2, image_size=75, num_classes=10)
+           if on_cpu else
+           InceptionConfig(batch_size=16, image_size=299, num_classes=1000))
+    ff = create_inception_v3(cfg, FFConfig(batch_size=cfg.batch_size))
+    ff.compile(AdamOptimizer(alpha=1e-4, state_dtype=jnp.bfloat16),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, 3, cfg.image_size,
+                 cfg.image_size).astype(np.float32)
+    y = rs.randint(0, cfg.num_classes,
+                   (cfg.batch_size, 1)).astype(np.int32)
+    return ff, [x], y, dataclasses.asdict(cfg)
+
+
+def build_dlrm(on_cpu):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.models.dlrm import DLRMConfig, create_dlrm
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    # reference AE config family (examples/cpp/DLRM/dlrm.cc defaults,
+    # run_random.sh: sparse-feature-size 64, embedding-bag-size 1):
+    # embedding-table traffic dominates — the parameter-parallel showcase
+    cfg = (DLRMConfig(batch_size=32, num_sparse_features=4,
+                      vocab_size=1000, embedding_dim=16)
+           if on_cpu else
+           DLRMConfig(batch_size=2048, num_sparse_features=8,
+                      vocab_size=1000000, embedding_dim=64))
+    ff = create_dlrm(cfg, FFConfig(batch_size=cfg.batch_size))
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    rs = np.random.RandomState(0)
+    xs = []
+    for name in ff.executor.input_names:
+        if name.startswith("sparse"):
+            xs.append(rs.randint(0, cfg.vocab_size,
+                                 (cfg.batch_size,
+                                  cfg.indices_per_feature)).astype(np.int32))
+        else:
+            xs.append(rs.randn(cfg.batch_size,
+                               cfg.dense_dim).astype(np.float32))
+    y = rs.randint(0, 2, (cfg.batch_size, 1)).astype(np.float32)
+    return ff, xs, y, dataclasses.asdict(cfg)
+
+
+def build_moe(on_cpu):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.models.moe_model import MoEConfig, create_moe
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    # reference moe.cc defaults scaled to saturate one chip: top-2 of 16
+    # experts over a 1024-wide hidden
+    cfg = (MoEConfig(batch_size=32, input_dim=64, num_exp=4, num_select=2,
+                     hidden_size=32)
+           if on_cpu else
+           MoEConfig(batch_size=1024, input_dim=1024, num_exp=16,
+                     num_select=2, hidden_size=1024, num_classes=1000))
+    ff = create_moe(cfg, FFConfig(batch_size=cfg.batch_size))
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, cfg.input_dim).astype(np.float32)
+    y = rs.randint(0, cfg.num_classes, (cfg.batch_size, 1)).astype(np.int32)
+    return ff, [x], y, dataclasses.asdict(cfg)
+
+
+WORKLOADS = [
+    ("bert_proxy", build_bert_proxy, 30),
+    ("inception_proxy", build_inception_proxy, 10),
+    ("dlrm", build_dlrm, 30),
+    ("moe", build_moe, 30),
+]
+
+
+def load_history():
+    path = os.path.join(REPO, "bench_history.json")
     hist = {}
-    if os.path.exists(hist_path):
+    if os.path.exists(path):
         try:
-            hist = json.load(open(hist_path))
+            hist = json.load(open(path))
         except Exception:
             hist = {}
     if "samples_per_s" in hist:
         # migrate the r1/r2 flat format; those rounds were recorded on the
         # TPU by the driver, so the number belongs to the tpu key
-        # regardless of where THIS run executes
         hist = {"bert_proxy:tpu": {"samples_per_s": hist["samples_per_s"]}}
-    # protocol tag (advisor r3): vs_baseline is only meaningful
-    # like-for-like. "best3x30" = best of 3 x 30-step windows (r3+);
-    # entries without a tag predate r3 but the ratcheted max already
-    # includes r3's best-of-3 run, so they are comparable going forward.
-    PROTOCOL = "best3x30"
-    entry = hist.get(workload) or {}
+    return path, hist
+
+
+def ratchet(hist, key, samples_per_s, config, protocol):
+    """Best-ever per workload key. The key is protocol name + platform
+    ONLY — never the config dict (a schema change must not reset the
+    ratchet; r2 lesson). `protocol` records the actual windows x iters
+    measured (e.g. "best3x30") so a drifted protocol is flagged, not
+    silently compared. Returns (vs_baseline, old_protocol_or_None)."""
+    entry = hist.get(key) or {}
     baseline = entry.get("samples_per_s")
-    vs_baseline = samples_per_s / baseline if baseline else 1.0
-    protocol_changed = bool(entry) and entry.get("protocol",
-                                                PROTOCOL) != PROTOCOL
+    vs = samples_per_s / baseline if baseline else 1.0
+    old = entry.get("protocol", protocol) if entry else protocol
+    if samples_per_s >= (baseline or 0.0):
+        hist[key] = {"samples_per_s": samples_per_s, "protocol": protocol,
+                     "config": config}
+    # else: keep the stored best AND its provenance untouched
+    return vs, (old if old != protocol else None)
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, REPO)
+    on_cpu = jax.devices()[0].platform == "cpu"
+    platform = "cpu" if on_cpu else "tpu"
+    hist_path, hist = load_history()
+
+    result = {}
+    workloads_out = {}
+    protocol_notes = []
+    for name, build, iters in WORKLOADS:
+        iters = 5 if on_cpu else iters
+        windows = 1 if on_cpu else 3
+        protocol = f"best{windows}x{iters}"
+        try:
+            ff, xs, y, cfg_dict = build(on_cpu)
+            sps = time_train(ff, xs, y, iters=iters, windows=windows)
+        except Exception as e:
+            if name == "bert_proxy":
+                raise  # the headline metric must never be silently absent
+            # a broken secondary family is a visible per-workload error,
+            # not a lost bench run (the driver parses the ONE JSON line)
+            workloads_out[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        vs, old_protocol = ratchet(hist, f"{name}:{platform}", sps,
+                                   cfg_dict, protocol)
+        if name == "bert_proxy":
+            result.update({
+                "metric": "bert_proxy_train_throughput",
+                "value": round(sps, 3),
+                "unit": "samples/s",
+                "vs_baseline": round(vs, 4),
+            })
+        else:
+            workloads_out[name] = {"value": round(sps, 3),
+                                   "vs_baseline": round(vs, 4)}
+        if old_protocol:
+            protocol_notes.append(f"{name}: {old_protocol} -> {protocol}")
+        del ff
     try:
-        if samples_per_s >= (baseline or 0.0):
-            hist[workload] = {
-                "samples_per_s": samples_per_s,
-                "protocol": PROTOCOL,
-                "config": dataclass_dict(cfg),
-            }
-        # else: keep the stored best AND its provenance (protocol/config)
-        # untouched — stamping the current tags onto an old best would
-        # falsify the baseline's provenance
         json.dump(hist, open(hist_path, "w"))
     except Exception:
         pass
-
-    result = {
-        "metric": "bert_proxy_train_throughput",
-        "value": round(samples_per_s, 3),
-        "unit": "samples/s",
-        "vs_baseline": round(vs_baseline, 4),
-    }
-    if protocol_changed:
-        result["protocol_change"] = (
-            f"{entry.get('protocol')} -> {PROTOCOL}: vs_baseline spans "
-            f"protocols")
+    result["workloads"] = workloads_out
+    if protocol_notes:
+        result["protocol_change"] = ("vs_baseline spans protocols — " +
+                                     "; ".join(protocol_notes))
     ratio = searched_vs_dp_ratio(on_cpu)
     if ratio is not None:
         # BASELINE.md north star: predicted searched/DP throughput on a
@@ -221,11 +341,6 @@ def searched_vs_dp_ratio(on_cpu):
         return out
     except Exception:
         return None
-
-
-def dataclass_dict(cfg):
-    import dataclasses
-    return dataclasses.asdict(cfg)
 
 
 if __name__ == "__main__":
