@@ -22,6 +22,9 @@ class InceptionConfig:
     batch_size: int = 64  # osdi22ae inception.sh batch
     image_size: int = 299
     num_classes: int = 1000
+    # reduced=True keeps the stem + ONE module of each family (a/b/c/d/e)
+    # — topology-representative but ~4x fewer convs, for CPU smoke runs
+    reduced: bool = False
 
 
 def _module_a(ff, x, pool_features, name):
@@ -97,16 +100,19 @@ def create_inception_v3(cfg: InceptionConfig, ff_config: FFConfig = None) -> FFM
     x = ff.conv2d(x, 192, 3, 3, 1, 1, 0, 0, activation=RELU)
     x = ff.pool2d(x, 3, 3, 2, 2, 0, 0)
     x = _module_a(ff, x, 32, "a1")
-    x = _module_a(ff, x, 64, "a2")
-    x = _module_a(ff, x, 64, "a3")
+    if not cfg.reduced:
+        x = _module_a(ff, x, 64, "a2")
+        x = _module_a(ff, x, 64, "a3")
     x = _module_b(ff, x, "b1")
     x = _module_c(ff, x, 128, "c1")
-    x = _module_c(ff, x, 160, "c2")
-    x = _module_c(ff, x, 160, "c3")
-    x = _module_c(ff, x, 192, "c4")
+    if not cfg.reduced:
+        x = _module_c(ff, x, 160, "c2")
+        x = _module_c(ff, x, 160, "c3")
+        x = _module_c(ff, x, 192, "c4")
     x = _module_d(ff, x, "d1")
     x = _module_e(ff, x, "e1")
-    x = _module_e(ff, x, "e2")
+    if not cfg.reduced:
+        x = _module_e(ff, x, "e2")
     x = ff.pool2d(x, x.shape[2], x.shape[3], 1, 1, 0, 0,
                   pool_type=PoolType.POOL_AVG)
     x = ff.flat(x)
