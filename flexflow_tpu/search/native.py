@@ -23,13 +23,23 @@ _lib = None
 _load_error: Optional[str] = None
 
 
-def _build() -> bool:
+def _build(clean: bool = False) -> bool:
     try:
+        if clean and os.path.exists(_LIB_PATH):
+            # fresh inode so a subsequent CDLL maps the NEW library (glibc
+            # returns the cached handle for an unchanged path+inode)
+            os.remove(_LIB_PATH)
         r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
                            timeout=120)
         return r.returncode == 0 and os.path.exists(_LIB_PATH)
     except Exception:
         return False
+
+
+# every export the current Python layer calls — a prebuilt .so missing any
+# of these is stale and gets one rebuild attempt
+_REQUIRED_SYMBOLS = ("ffs_optimize", "ffs_simulate", "ffs_list_rules",
+                     "ffs_match_rules", "ffs_free", "ffs_version")
 
 
 def get_lib():
@@ -44,13 +54,23 @@ def get_lib():
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-        lib.ffs_optimize.argtypes = [ctypes.c_char_p]
-        lib.ffs_optimize.restype = ctypes.c_void_p
-        lib.ffs_simulate.argtypes = [ctypes.c_char_p]
-        lib.ffs_simulate.restype = ctypes.c_void_p
-        if hasattr(lib, "ffs_list_rules"):
-            lib.ffs_list_rules.argtypes = [ctypes.c_char_p]
-            lib.ffs_list_rules.restype = ctypes.c_void_p
+        if not all(hasattr(lib, s) for s in _REQUIRED_SYMBOLS):
+            # stale prebuilt library from an older checkout: rebuild once
+            # (clean, so the reload maps the fresh inode) and reload
+            if not _build(clean=True):
+                _load_error = ("libffsearch.so is stale (missing exports) "
+                               "and rebuild failed — run `make -C native`")
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            missing = [s for s in _REQUIRED_SYMBOLS if not hasattr(lib, s)]
+            if missing:
+                _load_error = (f"libffsearch.so still missing exports "
+                               f"{missing} after rebuild")
+                return None
+        for fn in ("ffs_optimize", "ffs_simulate", "ffs_list_rules",
+                   "ffs_match_rules"):
+            getattr(lib, fn).argtypes = [ctypes.c_char_p]
+            getattr(lib, fn).restype = ctypes.c_void_p
         lib.ffs_free.argtypes = [ctypes.c_void_p]
         lib.ffs_version.restype = ctypes.c_char_p
         _lib = lib
@@ -87,6 +107,14 @@ def native_list_rules(rules: Any) -> Dict[str, Any]:
     """Parse a substitution rule corpus (reference RuleCollection JSON or
     the native list form); returns {"count": N, "names": [...]}."""
     return _call("ffs_list_rules", rules)
+
+
+def native_match_rules(request: Dict[str, Any]) -> Dict[str, Any]:
+    """Offline rule audit (corpus-sweep harness): for each rule in
+    request["subst_rules"], count matches on request["nodes"], how many
+    structurally apply, and whether every rewritten graph still prices
+    under the DP. Returns {rule_name: {matches, applied, priced}}."""
+    return _call("ffs_match_rules", request)
 
 
 def available() -> bool:

@@ -842,6 +842,49 @@ Json simulate_only(const Json& req) {
   return out;
 }
 
+// Offline rule audit (corpus verification harness): for each rule in
+// `subst_rules`, count pattern matches on the given graph and how many of
+// them apply_rule can structurally rewrite, and check every rewritten
+// graph still admits a data-parallel pricing (shape/topology integrity).
+// No cost gating — this answers "is the rule well-formed and applicable",
+// not "is it profitable" (the best-first loop answers that at search
+// time).
+Json match_only(const Json& req) {
+  Graph g = Graph::from_json(req.get("nodes"));
+  std::vector<SubstRule> rules = parse_rules(req.get("subst_rules"));
+  MachineModel m;
+  m.num_devices = 8;
+  SearchConfig cfg;
+  cfg.enable_parameter_parallel = true;
+  int64_t next_guid = 0;
+  for (const Node& n : g.nodes) next_guid = std::max(next_guid, n.guid + 1);
+  Json out = Json::object();
+  for (const SubstRule& rule : rules) {
+    auto matches = find_matches(g, rule, 64);
+    int applied = 0, priced = 0;
+    for (const Match& match : matches) {
+      int64_t guid = next_guid;
+      RewriteTraceEntry trace;
+      auto g2 = apply_rule(g, rule, match, &guid, &trace);
+      if (!g2) continue;
+      ++applied;
+      // integrity: the rewritten graph must still price under the DP
+      MeshShape mesh;
+      mesh.dp = 2;
+      mesh.mp = 2;
+      auto choices = all_choices(*g2, mesh, cfg);
+      DPResult dp = frontier_dp(*g2, choices, mesh, m, cfg, 0.0, nullptr);
+      if (dp.ok) ++priced;
+    }
+    Json rj = Json::object();
+    rj.set("matches", Json((int64_t)matches.size()));
+    rj.set("applied", Json((int64_t)applied));
+    rj.set("priced", Json((int64_t)priced));
+    out.set(rule.name, rj);
+  }
+  return out;
+}
+
 char* dup_string(const std::string& s) {
   char* p = static_cast<char*>(malloc(s.size() + 1));
   memcpy(p, s.c_str(), s.size() + 1);
@@ -893,6 +936,19 @@ char* ffs_simulate(const char* request_json) {
   try {
     ffsearch::Json req = ffsearch::Json::parse(request_json);
     return ffsearch::dup_string(ffsearch::simulate_only(req).dump());
+  } catch (const std::exception& e) {
+    ffsearch::Json err = ffsearch::Json::object();
+    err.set("error", ffsearch::Json(std::string(e.what())));
+    return ffsearch::dup_string(err.dump());
+  }
+}
+
+// Offline rule audit: {"nodes": [...], "subst_rules": [...]} ->
+// {rule_name: {matches, applied, priced}} (corpus-sweep harness).
+char* ffs_match_rules(const char* request_json) {
+  try {
+    ffsearch::Json req = ffsearch::Json::parse(request_json);
+    return ffsearch::dup_string(ffsearch::match_only(req).dump());
   } catch (const std::exception& e) {
     ffsearch::Json err = ffsearch::Json::object();
     err.set("error", ffsearch::Json(std::string(e.what())));

@@ -684,10 +684,18 @@ inline std::optional<Graph> apply_rule(const Graph& g, const SubstRule& rule,
       n.output_shapes = {in_shapes[0]};
       n.fwd_flops = 0;
       n.params.clear();
-    } else if (t == "EW_ADD" || t == "EW_MUL") {
+    } else if (t.rfind("EW_", 0) == 0) {
       if (in_shapes.size() != 2) return std::nullopt;
-      // broadcast
       const Shape &a = in_shapes[0], &b = in_shapes[1];
+      // Soundness: rules that move parallel ops across a binary assume
+      // dim index i means the same logical axis in BOTH operands; under
+      // rank-mismatched broadcast (e.g. bias [D] against [B,S,D]) dim 0
+      // of the low-rank operand is a different axis and the rewrite
+      // would shard operands inconsistently. Equal rank restores the
+      // correspondence; size-1 broadcast dims stay safe because the
+      // parallel-op emission's divisibility check (1 % deg) rejects
+      // sharding them.
+      if (a.size() != b.size()) return std::nullopt;
       size_t rank = std::max(a.size(), b.size());
       Shape o(rank, 1);
       for (size_t i = 0; i < rank; ++i) {

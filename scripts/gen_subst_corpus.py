@@ -20,8 +20,9 @@ WILD = lambda v: -1000.0 - v  # ffs_subst.hpp wildcard encoding
 UNARY = ["RELU", "GELU", "SIGMOID", "TANH", "ELU", "EXP", "SIN", "COS",
          "RSQRT", "IDENTITY", "DROPOUT", "CAST", "SCALAR_MULTIPLY",
          "SCALAR_ADD", "SCALAR_SUB", "SCALAR_TRUE_DIV"]
-BINARY = ["EW_ADD", "EW_MUL"]
+BINARY = ["EW_ADD", "EW_MUL", "EW_SUB", "EW_DIV", "EW_MAX", "EW_MIN"]
 GRID = ["CONV2D", "POOL2D", "BATCHNORM", "LAYERNORM"]
+NDIMS = 4  # fixed-dim variants cover ranks up to 4
 
 
 def op(typ, inputs, para=None):
@@ -46,30 +47,43 @@ def rule(name, src, dst, mapped):
 
 def generate():
     rules = []
+    # dim variants: the wildcard rule plus fixed-dim instantiations 0..3
+    # (the reference's TASO corpus is exactly this kind of systematic
+    # expansion — fixed parameters over an op vocabulary; fixed-dim
+    # variants also keep firing when a corpus REPLACES the wildcard
+    # builtins via --substitution-json)
+    DIMS = [None] + list(range(NDIMS))
+
+    def tag(d):
+        return "" if d is None else f"_d{d}"
+
     # family 1: Combine past every unary (work stays sharded)
     for u in UNARY:
-        rules.append(rule(
-            f"corpus_move_combine_past_{u}",
-            [op("COMBINE", [(-1, 0)], pdim()), op(u, [(0, 0)])],
-            [op(u, [(-1, 0)]), op("COMBINE", [(0, 0)], pdim())],
-            [(1, 0, 1, 0)]))
+        for d in DIMS:
+            rules.append(rule(
+                f"corpus_move_combine_past_{u}{tag(d)}",
+                [op("COMBINE", [(-1, 0)], pdim(d=d)), op(u, [(0, 0)])],
+                [op(u, [(-1, 0)]), op("COMBINE", [(0, 0)], pdim(d=d))],
+                [(1, 0, 1, 0)]))
     # family 2: Repartition above every unary (shard earlier)
     for u in UNARY:
-        rules.append(rule(
-            f"corpus_move_repartition_before_{u}",
-            [op(u, [(-1, 0)]), op("REPARTITION", [(0, 0)], pdim())],
-            [op("REPARTITION", [(-1, 0)], pdim()), op(u, [(0, 0)])],
-            [(1, 0, 1, 0)]))
+        for d in DIMS:
+            rules.append(rule(
+                f"corpus_move_repartition_before_{u}{tag(d)}",
+                [op(u, [(-1, 0)]), op("REPARTITION", [(0, 0)], pdim(d=d))],
+                [op("REPARTITION", [(-1, 0)], pdim(d=d)), op(u, [(0, 0)])],
+                [(1, 0, 1, 0)]))
     # family 3: Combines past every binary (two gathers -> one)
     for b in BINARY:
-        rules.append(rule(
-            f"corpus_move_combines_past_{b}",
-            [op("COMBINE", [(-1, 0)], pdim()),
-             op("COMBINE", [(-2, 0)], pdim()),
-             op(b, [(0, 0), (1, 0)])],
-            [op(b, [(-1, 0), (-2, 0)]),
-             op("COMBINE", [(0, 0)], pdim())],
-            [(2, 0, 1, 0)]))
+        for d in DIMS:
+            rules.append(rule(
+                f"corpus_move_combines_past_{b}{tag(d)}",
+                [op("COMBINE", [(-1, 0)], pdim(d=d)),
+                 op("COMBINE", [(-2, 0)], pdim(d=d)),
+                 op(b, [(0, 0), (1, 0)])],
+                [op(b, [(-1, 0), (-2, 0)]),
+                 op("COMBINE", [(0, 0)], pdim(d=d))],
+                [(2, 0, 1, 0)]))
     # family 4: batch-dim Combine past grid ops (sharded conv/pool/bn)
     for g in GRID:
         rules.append(rule(
@@ -78,17 +92,39 @@ def generate():
             [op(g, [(-1, 0)]), op("COMBINE", [(0, 0)], pdim(d=0))],
             [(1, 0, 1, 0)]))
     # family 5: Concat of same-degree Combines -> Concat + one Combine
+    # (2- and 3-input variants; the reference's corpus enumerates concat
+    # arities the same way)
+    for nin in (2, 3):
+        for d in range(4):
+            for a in range(4):
+                if a == d:
+                    continue  # same-dim would interleave shard groups
+                srcs = [op("COMBINE", [(-1 - i, 0)], pdim(d=d))
+                        for i in range(nin)]
+                srcs.append(op("CONCAT", [(i, 0) for i in range(nin)],
+                               {"PM_AXIS": float(a)}))
+                name = (f"corpus_concat_of_combines_d{d}_a{a}" if nin == 2
+                        else f"corpus_concat{nin}_of_combines_d{d}_a{a}")
+                rules.append(rule(
+                    name,
+                    srcs,
+                    [op("CONCAT", [(-1 - i, 0) for i in range(nin)],
+                        {"PM_AXIS": float(a)}),
+                     op("COMBINE", [(0, 0)], pdim(d=d))],
+                    [(nin, 0, 1, 0)]))
+    # family 5b: Concat of same-dim Repartitions -> Concat + one
+    # Repartition (mirror of 5 on the sharding side)
     for d in range(4):
         for a in range(4):
             if a == d:
-                continue  # same-dim would interleave shard groups
+                continue
             rules.append(rule(
-                f"corpus_concat_of_combines_d{d}_a{a}",
-                [op("COMBINE", [(-1, 0)], pdim(d=d)),
-                 op("COMBINE", [(-2, 0)], pdim(d=d)),
+                f"corpus_concat_of_repartitions_d{d}_a{a}",
+                [op("REPARTITION", [(-1, 0)], pdim(d=d)),
+                 op("REPARTITION", [(-2, 0)], pdim(d=d)),
                  op("CONCAT", [(0, 0), (1, 0)], {"PM_AXIS": float(a)})],
                 [op("CONCAT", [(-1, 0), (-2, 0)], {"PM_AXIS": float(a)}),
-                 op("COMBINE", [(0, 0)], pdim(d=d))],
+                 op("REPARTITION", [(0, 0)], pdim(d=d))],
                 [(2, 0, 1, 0)]))
     # family 6: inverse-pair elimination at fixed dims (the wildcard
     # builtins cover the general case; fixed-dim variants keep firing when
@@ -100,10 +136,58 @@ def generate():
              op("COMBINE", [(0, 0)], pdim(d=d))],
             [op("IDENTITY", [(-1, 0)])],
             [(1, 0, 0, 0)]))
+    # family 11: Replicate past every unary (the broadcast boundary
+    # commutes with elementwise work; mirrors family 1 for REPLICATE)
+    for u in UNARY:
+        rules.append(rule(
+            f"corpus_move_replicate_past_{u}",
+            [op("REPLICATE", [(-1, 0)], pdim()), op(u, [(0, 0)])],
+            [op(u, [(-1, 0)]), op("REPLICATE", [(0, 0)], pdim())],
+            [(1, 0, 1, 0)]))
+    # family 12: Repartition below every binary -> repartition both
+    # operands first (shards the elementwise work itself)
+    for b in BINARY:
+        for d in DIMS:
+            rules.append(rule(
+                f"corpus_shard_{b}_via_repartition{tag(d)}",
+                [op(b, [(-1, 0), (-2, 0)]),
+                 op("REPARTITION", [(0, 0)], pdim(d=d))],
+                [op("REPARTITION", [(-1, 0)], pdim(d=d)),
+                 op("REPARTITION", [(-2, 0)], pdim(d=d)),
+                 op(b, [(0, 0), (1, 0)])],
+                [(1, 0, 2, 0)]))
+    # family 13: binary of two same-dim Repartitions -> binary then one
+    # Repartition (inverse of 12: halves the boundary count)
+    for b in BINARY:
+        for d in range(4):
+            rules.append(rule(
+                f"corpus_merge_repartitions_below_{b}_d{d}",
+                [op("REPARTITION", [(-1, 0)], pdim(d=d)),
+                 op("REPARTITION", [(-2, 0)], pdim(d=d)),
+                 op(b, [(0, 0), (1, 0)])],
+                [op(b, [(-1, 0), (-2, 0)]),
+                 op("REPARTITION", [(0, 0)], pdim(d=d))],
+                [(2, 0, 1, 0)]))
+    # family 14: Repartition(d1) -> Repartition over a second dim d2
+    # collapses into one FusedParallelOp boundary (two resharding
+    # collectives become one)
+    for d1 in range(3):
+        for d2 in range(3):
+            if d1 == d2:
+                continue
+            rules.append(rule(
+                f"corpus_fuse_parallel_ops_part{d1}_part{d2}",
+                [op("REPARTITION", [(-1, 0)], pdim(d=d1)),
+                 op("REPARTITION", [(0, 0)],
+                    {"PM_PARALLEL_DIM": float(d2),
+                     "PM_PARALLEL_DEGREE": WILD(3)})],
+                [op("FUSED_PARALLEL", [(-1, 0)])],
+                [(1, 0, 0, 0)]))
     # --- r4 algebraic compute-rewrite families -------------------------
     # family 7: N same-input Linears -> one wide Linear + N-way Split
-    # (N=3 is the transformer QKV-projection merge)
-    for nway in (2, 3, 4):
+    # (N=3 is the transformer QKV-projection merge; wider N cover
+    # multi-branch towers)
+    for nway in (2, 3, 4, 6, 8):
         rules.append(rule(
             f"corpus_fuse_parallel_linears{nway}",
             [op("LINEAR", [(-1, 0)], {"PM_ACTI": WILD(2)})

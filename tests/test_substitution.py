@@ -677,3 +677,171 @@ class TestComputeRewriteFamilies:
             dict(base, config=dict(_cfg(budget=3), perform_fusion=False)))
         rules = [r["rule"] for r in resp.get("rewrites", [])]
         assert not any("fuse_parallel_ops" in r for r in rules), rules
+
+
+class TestNewCorpusFamilyNumerics:
+    """r5 corpus families (5b, 11, 12, 13, 14): executor-level parity —
+    compile WITH the single rule vs WITHOUT substitution, copy weights by
+    layer name, and the predictions must match (all five are layout
+    rewrites, value-preserving by construction)."""
+
+    def _rule(self, name):
+        import json as _json
+        corpus = _json.load(open(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "substitutions", "ffs_subst_v1.json")))
+        return next(r for r in corpus if r["name"] == name)
+
+    def _parity(self, build, rule_name, tmp_path, x, workers=2):
+        import json as _json
+
+        from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+        path = tmp_path / "rule.json"
+        path.write_text(_json.dumps([self._rule(rule_name)]))
+        outs = {}
+        fired = None
+        for key, kw in (("plain", dict(enable_substitution=False)),
+                        ("rewritten",
+                         dict(substitution_json=str(path)))):
+            # device count pinned so the searched mesh's axis extents can
+            # match the graph's explicit degree-2 parallel ops (GSPMD
+            # legality: degree == axis extent)
+            cfg = FFConfig(batch_size=x.shape[0], search_budget=4,
+                           enable_parameter_parallel=True,
+                           workers_per_node=workers, num_nodes=1, **kw)
+            ff = FFModel(cfg)
+            build(ff)
+            ff.compile(SGDOptimizer(lr=0.05),
+                       LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+            if key == "plain":
+                ref = ff
+            else:
+                fired = [r["rule"] for r in
+                         (ff.search_info or {}).get("rewrites", [])]
+                for name in ff.get_layer_names():
+                    for pname in list(ref.params.get(name, {})):
+                        try:
+                            ff.set_parameter(
+                                name, ref.get_parameter(name, pname), pname)
+                        except KeyError:
+                            pass
+            outs[key] = ff.predict(x)
+        np.testing.assert_allclose(outs["rewritten"], outs["plain"],
+                                   rtol=2e-4, atol=2e-5)
+        return fired
+
+    def test_replicate_past_unary(self, tmp_path):
+        def build(ff):
+            t = ff.create_tensor((32, 16))
+            h = ff.dense(t, 16, name="fc")
+            h = ff.replicate(h, degree=2)
+            h = ff.relu(h)
+            ff.dense(h, 8, name="out")
+
+        rs = np.random.RandomState(0)
+        self._parity(build, "corpus_move_replicate_past_RELU",
+                     tmp_path, rs.randn(32, 16).astype(np.float32))
+
+    def test_merge_repartitions_below_binary(self, tmp_path):
+        def build(ff):
+            t = ff.create_tensor((32, 16))
+            a = ff.dense(t, 16, name="a")
+            # different producer for b, so the builtin same-input QKV
+            # merge (fuse_parallel_linears) can't fire and re-init weights
+            b = ff.dense(ff.scalar_multiply(t, 0.5), 16, name="b")
+            a = ff.repartition(a, dim=0, degree=2)
+            b = ff.repartition(b, dim=0, degree=2)
+            ff.add(a, b)
+
+        rs = np.random.RandomState(1)
+        self._parity(build, "corpus_merge_repartitions_below_EW_ADD_d0",
+                     tmp_path, rs.randn(32, 16).astype(np.float32))
+
+    def test_shard_binary_via_repartition(self, tmp_path):
+        def build(ff):
+            t = ff.create_tensor((32, 16))
+            a = ff.dense(t, 16, name="a")
+            b = ff.dense(ff.scalar_multiply(t, 0.5), 16, name="b")
+            s = ff.add(a, b)
+            ff.repartition(s, dim=0, degree=2)
+
+        rs = np.random.RandomState(2)
+        self._parity(build, "corpus_shard_EW_ADD_via_repartition_d0",
+                     tmp_path, rs.randn(32, 16).astype(np.float32))
+
+    def test_concat_of_repartitions(self, tmp_path):
+        def build(ff):
+            t = ff.create_tensor((32, 16))
+            a = ff.dense(t, 16, name="a")
+            b = ff.dense(ff.scalar_multiply(t, 0.5), 16, name="b")
+            a = ff.repartition(a, dim=0, degree=2)
+            b = ff.repartition(b, dim=0, degree=2)
+            ff.concat([a, b], axis=1)
+
+        rs = np.random.RandomState(3)
+        self._parity(build, "corpus_concat_of_repartitions_d0_a1",
+                     tmp_path, rs.randn(32, 16).astype(np.float32))
+
+    def test_fuse_repartition_repartition(self):
+        """Family 14 at the native level: Repartition(d0) -> Repartition(d1)
+        collapses into one FUSED_PARALLEL boundary (executor numerics of
+        FUSED_PARALLEL are covered by the family-10 compile test)."""
+        import json as _json
+        corpus = _json.load(open(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "substitutions", "ffs_subst_v1.json")))
+        rule = next(r for r in corpus
+                    if r["name"] == "corpus_fuse_parallel_ops_part0_part1")
+        b, d = 2048, 1024
+        nodes = [
+            _linear(1, "fc", [-2, 0], b, d, d),
+            _node(2, "REPARTITION", "rp0", [[1, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 0, "degree": 2}),
+            _node(3, "REPARTITION", "rp1", [[2, 0]], [[b, d]], [[b, d]],
+                  attrs={"dim": 1, "degree": 2}),
+            _linear(4, "fc2", [3, 0], b, d, d),
+        ]
+        resp = native_optimize({
+            "machine": MACHINE, "measured": {}, "nodes": nodes,
+            "final": [4, 0],
+            "config": _cfg(budget=3, rules=[], subst_budget=16),
+            "subst_rules": [rule]})
+        fired = [r["rule"] for r in resp.get("rewrites", [])]
+        assert any("fuse_parallel_ops_part0_part1" in r for r in fired), fired
+        added = next(r for r in resp["rewrites"]
+                     if "fuse_parallel_ops_part0_part1" in r["rule"])
+        assert added["added"][0]["type"] == "FUSED_PARALLEL"
+
+    def test_broadcast_rank_mismatch_is_rejected(self):
+        """Soundness guard: a rule moving parallel ops across a binary
+        must NOT apply when the operands' ranks differ (dim indices
+        would refer to different logical axes — advisor r5 finding)."""
+        from flexflow_tpu.search.native import native_match_rules
+
+        import json as _json
+        corpus = _json.load(open(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "substitutions", "ffs_subst_v1.json")))
+        rule = next(r for r in corpus
+                    if r["name"] == "corpus_shard_EW_ADD_via_repartition_d0")
+        b = 8
+        nodes = [
+            {"guid": 1, "type": "EW_ADD", "name": "add",
+             "inputs": [[-1, 0], [-2, 0]],
+             "input_shapes": [[b, 4, 6, 8], [6, 8]],
+             "output_shapes": [[b, 4, 6, 8]],
+             "roles": [["sample", "other", "other", "other"]],
+             "params": {}, "flops": float(b * 4 * 6 * 8),
+             "dtype_size": 4, "attrs": {}},
+            {"guid": 2, "type": "REPARTITION", "name": "rp",
+             "inputs": [[1, 0]], "input_shapes": [[b, 4, 6, 8]],
+             "output_shapes": [[b, 4, 6, 8]],
+             "roles": [["sample", "other", "other", "other"]],
+             "params": {}, "flops": 0.0, "dtype_size": 4,
+             "attrs": {"dim": 0, "degree": 2}},
+        ]
+        resp = native_match_rules({"nodes": nodes, "subst_rules": [rule]})
+        stats = resp[rule["name"]]
+        assert stats["applied"] == 0, (
+            f"rank-mismatched broadcast must not rewrite: {stats}")
