@@ -436,6 +436,57 @@ class FFModel:
         return self._finish(layer)
 
     # ======================= compile ========================================
+    def _materialize_nodes(self, input_shape_overrides=None):
+        """Layer -> Op materialization (create_operators_from_layers,
+        model.cc:2784). With `input_shape_overrides` ({input layer name ->
+        shape}) every intermediate shape is re-derived from the overridden
+        INPUT shapes — the seq-length bucket path (FFIterationConfig
+        analog, reference config.h:162-167) materializes the same layer
+        graph at a shorter sequence this way.
+
+        Returns (nodes, input_names, tensor_ref)."""
+        nodes: List[OpNode] = []
+        tensor_ref: Dict[int, Tuple] = {}  # Tensor.guid -> ref
+        input_names: List[str] = []
+        shape_of: Dict[int, Tuple[int, ...]] = {}
+        for layer in self.layers:
+            if layer.op_type == OperatorType.INPUT:
+                t = layer.outputs[0]
+                shape_of[t.guid] = tuple(
+                    (input_shape_overrides or {}).get(layer.name, t.shape))
+                tensor_ref[t.guid] = ("input", layer.name)
+                input_names.append(layer.name)
+                continue
+            op = OpRegistry.create(
+                layer, [shape_of.get(t.guid, t.shape) for t in layer.inputs])
+            refs = [tensor_ref[t.guid] for t in layer.inputs]
+            nodes.append(OpNode(op, refs))
+            for i, t in enumerate(layer.outputs):
+                tensor_ref[t.guid] = ("op", op.guid, i)
+                shape_of[t.guid] = op.output_shapes[i]
+        return nodes, input_names, tensor_ref
+
+    def _select_final_ref(self, nodes, tensor_ref):
+        """Output selection (get_final_operator, model.cc:2476): the
+        user-designated tensor, else the sole unconsumed output of the
+        final node."""
+        out_t = getattr(self, "outputs", None)
+        if out_t is not None:
+            ref = tensor_ref.get(out_t.guid)
+            if ref is None or ref[0] != "op":
+                raise ValueError("outputs= must be a tensor produced by a layer")
+            return (ref[1], ref[2])
+        final_node = nodes[-1]
+        consumed = {
+            tensor_ref[t.guid][1:]
+            for layer in self.layers
+            for t in layer.inputs
+            if tensor_ref.get(t.guid, ("x",))[0] == "op"
+        }
+        free = [i for i in range(len(final_node.op.output_shapes))
+                if (final_node.guid, i) not in consumed]
+        return (final_node.guid, free[0] if len(free) == 1 else 0)
+
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                 metrics: Sequence[MetricsType] = (),
@@ -456,22 +507,7 @@ class FFModel:
         self.loss_type = loss_type
 
         # --- create_operators_from_layers (model.cc:2784) ---
-        nodes: List[OpNode] = []
-        tensor_ref: Dict[int, Tuple] = {}  # Tensor.guid -> ref
-        input_names: List[str] = []
-        for layer in self.layers:
-            if layer.op_type == OperatorType.INPUT:
-                t = layer.outputs[0]
-                tensor_ref[t.guid] = ("input", layer.name)
-                input_names.append(layer.name)
-                continue
-            op = OpRegistry.create(layer, [t.shape for t in layer.inputs])
-            refs = [tensor_ref[t.guid] for t in layer.inputs]
-            node = OpNode(op, refs)
-            nodes.append(node)
-            for i, t in enumerate(layer.outputs):
-                tensor_ref[t.guid] = ("op", op.guid, i)
-
+        nodes, input_names, tensor_ref = self._materialize_nodes()
         if not nodes:
             raise ValueError("model has no layers")
         # --- output selection (get_final_operator, model.cc:2476) ---
@@ -485,22 +521,7 @@ class FFModel:
             out_t = out_t[0]
         # persist so recompile_on_condition's re-compile keeps the selection
         self.outputs = out_t
-        if out_t is not None:
-            ref = tensor_ref.get(out_t.guid)
-            if ref is None or ref[0] != "op":
-                raise ValueError("outputs= must be a tensor produced by a layer")
-            final_ref = (ref[1], ref[2])
-        else:
-            final_node = nodes[-1]
-            consumed = {
-                tensor_ref[t.guid][1:]
-                for layer in self.layers
-                for t in layer.inputs
-                if tensor_ref.get(t.guid, ("x",))[0] == "op"
-            }
-            free = [i for i in range(len(final_node.op.output_shapes))
-                    if (final_node.guid, i) not in consumed]
-            final_ref = (final_node.guid, free[0] if len(free) == 1 else 0)
+        final_ref = self._select_final_ref(nodes, tensor_ref)
         final_node = next(n for n in nodes if n.guid == final_ref[0])
         self._final_is_softmax = final_node.op.op_type == OperatorType.SOFTMAX
         self.metrics = Metrics(loss_type, list(metrics),
@@ -705,6 +726,8 @@ class FFModel:
         self.opt_state = (None if comp_mode == CompMode.INFERENCE
                           else self.optimizer.init(self.params))
         self._iter = 0
+        self._seq_execs: Dict[int, Any] = {}  # seq-length bucket executors
+        self._declared_seq_cache = -1  # lazily derived (-1 = not yet)
 
     # ======================= data staging ==================================
     def _shard_batch(self, arr: np.ndarray, cast: bool = False) -> jax.Array:
@@ -856,10 +879,15 @@ class FFModel:
         return np.asarray(out)
 
     # ---- reference-parity iteration protocol ------------------------------
-    # (forward / zero_gradients / backward / update — model.cc:2415-2475.
-    # Under XLA these are phases of one fused jitted step; we keep the API
-    # by staging the batch in forward() and running the fused step in
-    # update(). begin/end_trace are no-ops: jit IS the trace.)
+    # (forward / backward / update with FFIterationConfig.seq_length —
+    # model.cc:2415-2475 + config.h:162-167. Under XLA these are phases of
+    # one fused jitted step; we keep the API by staging the batch in
+    # set_batch and running the fused step in update(). A seq_length
+    # shorter than the model's declared sequence dispatches to a BUCKET
+    # executor: the same layer graph re-materialized at the next
+    # power-of-two length, so every op — not just BatchMatmul — skips the
+    # compute beyond the active length while jit sees only a bounded set
+    # of static shapes. begin/end_trace are no-ops: jit IS the trace.)
     def set_batch(self, x, y):
         self._current_batch = (self._stage_inputs(x if isinstance(x, (list, tuple)) else [x]),
                                self._shard_batch(y))
@@ -867,17 +895,125 @@ class FFModel:
     def forward(self, seq_length: Optional[int] = None):
         if self._current_batch is None:
             raise ValueError("call set_batch(x, y) before forward()")
+        self._iter_seq = seq_length
         self._pending = "forward"
 
     def zero_gradients(self):
         pass
 
     def backward(self, seq_length: Optional[int] = None):
+        if seq_length is not None:
+            self._iter_seq = seq_length
         self._pending = "backward"
+
+    def _declared_seq(self) -> Optional[int]:
+        """The model's sequence extent: the dim any op marks with the SEQ
+        role (attention and friends). None = no sequence dim (MLP/conv),
+        in which case seq_length iteration args are ignored — matching
+        the reference, where only seq ops consume FFIterationConfig."""
+        if self._declared_seq_cache != -1:
+            return self._declared_seq_cache
+        from flexflow_tpu.ops.base import DimRole
+        found = None
+        for node in self.executor.nodes:
+            for shp, roles in zip(node.op.output_shapes,
+                                  node.op.output_dim_roles()):
+                for d, r in enumerate(roles):
+                    if r == DimRole.SEQ:
+                        found = shp[d]
+                        break
+        self._declared_seq_cache = found
+        return found
+
+    def _seq_bucket(self, seq_length: Optional[int]) -> Optional[int]:
+        """Bucketed static length for an iteration's seq_length: the next
+        power of two (>=16), None when the full-length step applies."""
+        declared = self._declared_seq()
+        if not seq_length or declared is None or seq_length >= declared:
+            return None
+        if isinstance(self.search_info, dict) \
+                and self.search_info.get("rewritten_nodes") is not None:
+            return None  # strategy is keyed to the rewritten graph
+        from flexflow_tpu.executor import GraphExecutor
+        if type(self.executor) is not GraphExecutor:
+            return None  # pipeline bodies are stacked at full length
+        # at least one INPUT must carry the sequence at dim 1, or the
+        # bucket graph would equal the full graph while update() slices —
+        # degrade to the full-length step instead
+        if not any(len(layer.outputs[0].shape) >= 2
+                   and layer.outputs[0].shape[1] == declared
+                   for layer in self.layers
+                   if layer.op_type == OperatorType.INPUT):
+            return None
+        b = 16
+        while b < seq_length:
+            b *= 2
+        return b if b < declared else None
+
+    def _bucket_executor(self, bucket: int):
+        """GraphExecutor for the layer graph re-materialized at `bucket`
+        sequence length; params/opt state/op state are shared with the
+        full-length executor (layer guids are stable, and no parameter
+        shape depends on the sequence extent)."""
+        ex = self._seq_execs.get(bucket)
+        if ex is not None:
+            return ex
+        from flexflow_tpu.executor import GraphExecutor
+        from flexflow_tpu.parallel.strategy import apply_strategy
+        declared = self._declared_seq()
+        overrides = {}
+        for layer in self.layers:
+            if layer.op_type != OperatorType.INPUT:
+                continue
+            shp = list(layer.outputs[0].shape)
+            if sum(1 for e in shp[1:] if e == declared) > 1:
+                raise NotImplementedError(
+                    f"seq_length buckets: input '{layer.name}' shape "
+                    f"{tuple(shp)} carries the sequence extent on more "
+                    f"than one dim (e.g. an [B,S,S] mask) — ambiguous "
+                    f"to slice")
+            if len(shp) >= 2 and shp[1] == declared:
+                shp[1] = bucket
+                overrides[layer.name] = tuple(shp)
+        nodes, input_names, tensor_ref = self._materialize_nodes(overrides)
+        final_ref = self._select_final_ref(nodes, tensor_ref)
+        apply_strategy(nodes, self.strategy, self.mesh)
+        full = self.executor
+        ex = GraphExecutor(nodes, input_names, final_ref, self.mesh,
+                           self.loss_type, self.metrics, self.optimizer,
+                           compute_dtype=full.compute_dtype,
+                           data_axes=full.data_axes,
+                           final_is_softmax=self._final_is_softmax)
+        ex.comp_mode = full.comp_mode
+        self._seq_execs[bucket] = ex
+        return ex
+
+    def _slice_seq(self, arr, bucket: int):
+        declared = self._declared_seq()
+        if arr.ndim >= 2 and arr.shape[1] == declared:
+            return arr[:, :bucket]
+        return arr
+
+    def _final_output_has_seq(self) -> bool:
+        """Token-level model (output carries a SEQ dim) => labels slice
+        with the sequence; pooled heads (e.g. an S-class classifier whose
+        label dim coincidentally equals S) keep full labels."""
+        from flexflow_tpu.ops.base import DimRole
+        guid, idx = self.executor.final_ref
+        node = next(n for n in self.executor.nodes if n.op.guid == guid)
+        return DimRole.SEQ in node.op.output_dim_roles()[idx]
 
     def update(self):
         inputs, labels = self._current_batch
-        train_step = self.executor.make_train_step()
+        ex = self.executor
+        bucket = self._seq_bucket(getattr(self, "_iter_seq", None))
+        if bucket is not None:
+            ex = self._bucket_executor(bucket)
+            inputs = {k: self._slice_seq(v, bucket)
+                      for k, v in inputs.items()}
+            if self._final_output_has_seq():
+                labels = self._slice_seq(labels, bucket)
+        train_step = ex.make_train_step()
         self._refresh_compute_params()
         self._rng, sub = jax.random.split(self._rng)
         (self.params, self.opt_state, self.state, self._last_loss, self._last_metrics) = \

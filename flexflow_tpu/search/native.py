@@ -24,22 +24,35 @@ _load_error: Optional[str] = None
 
 
 def _build(clean: bool = False) -> bool:
+    backup = None
     try:
         if clean and os.path.exists(_LIB_PATH):
-            # fresh inode so a subsequent CDLL maps the NEW library (glibc
-            # returns the cached handle for an unchanged path+inode)
-            os.remove(_LIB_PATH)
+            # move (not delete) the current library aside: the rebuild
+            # gets a fresh inode (glibc dlopen caches by path+inode), and
+            # a failed rebuild restores the working .so instead of
+            # destroying it
+            backup = _LIB_PATH + ".stale"
+            os.replace(_LIB_PATH, backup)
         r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
                            timeout=120)
-        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+        ok = r.returncode == 0 and os.path.exists(_LIB_PATH)
+        if ok and backup is not None:
+            os.remove(backup)
+            backup = None
+        return ok
     except Exception:
         return False
+    finally:
+        if backup is not None and not os.path.exists(_LIB_PATH):
+            os.replace(backup, _LIB_PATH)
 
 
-# every export the current Python layer calls — a prebuilt .so missing any
-# of these is stale and gets one rebuild attempt
-_REQUIRED_SYMBOLS = ("ffs_optimize", "ffs_simulate", "ffs_list_rules",
-                     "ffs_match_rules", "ffs_free", "ffs_version")
+# exports the load-bearing paths need (search + simulator); a library
+# missing one of these is unusable
+_CORE_SYMBOLS = ("ffs_optimize", "ffs_simulate", "ffs_free", "ffs_version")
+# newer audit/tooling exports: their absence marks a stale build worth
+# one rebuild attempt, but never disables the core search
+_OPTIONAL_SYMBOLS = ("ffs_list_rules", "ffs_match_rules")
 
 
 def get_lib():
@@ -54,21 +67,20 @@ def get_lib():
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-        if not all(hasattr(lib, s) for s in _REQUIRED_SYMBOLS):
-            # stale prebuilt library from an older checkout: rebuild once
-            # (clean, so the reload maps the fresh inode) and reload
-            if not _build(clean=True):
-                _load_error = ("libffsearch.so is stale (missing exports) "
-                               "and rebuild failed — run `make -C native`")
-                return None
-            lib = ctypes.CDLL(_LIB_PATH)
-            missing = [s for s in _REQUIRED_SYMBOLS if not hasattr(lib, s)]
-            if missing:
-                _load_error = (f"libffsearch.so still missing exports "
-                               f"{missing} after rebuild")
-                return None
-        for fn in ("ffs_optimize", "ffs_simulate", "ffs_list_rules",
-                   "ffs_match_rules"):
+        if not all(hasattr(lib, s)
+                   for s in _CORE_SYMBOLS + _OPTIONAL_SYMBOLS):
+            # stale prebuilt library from an older checkout: one rebuild
+            # attempt; on failure keep whatever the current library CAN
+            # do (a failed rebuild restores the old .so — _build)
+            if _build(clean=True):
+                lib = ctypes.CDLL(_LIB_PATH)
+        missing_core = [s for s in _CORE_SYMBOLS if not hasattr(lib, s)]
+        if missing_core:
+            _load_error = (f"libffsearch.so missing core exports "
+                           f"{missing_core} — run `make -C native`")
+            return None
+        for fn in ("ffs_optimize", "ffs_simulate") + tuple(
+                s for s in _OPTIONAL_SYMBOLS if hasattr(lib, s)):
             getattr(lib, fn).argtypes = [ctypes.c_char_p]
             getattr(lib, fn).restype = ctypes.c_void_p
         lib.ffs_free.argtypes = [ctypes.c_void_p]
@@ -84,6 +96,10 @@ def _call(fn_name: str, request: Dict[str, Any]) -> Dict[str, Any]:
     lib = get_lib()
     if lib is None:
         raise RuntimeError(f"ffsearch native library unavailable: {_load_error}")
+    if not hasattr(lib, fn_name):
+        raise RuntimeError(
+            f"libffsearch.so has no '{fn_name}' export (stale build and "
+            f"rebuild unavailable) — run `make -C native`")
     fn = getattr(lib, fn_name)
     ptr = fn(json.dumps(request).encode())
     try:

@@ -225,10 +225,19 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # repo's native list-of-rules form
             subst_rules = data
     threshold = 0
+    mem_correction = 1.0
     if config.memory_search and config.memory_threshold_mb:
         threshold = config.memory_threshold_mb * (1 << 20)
     elif config.memory_search:
         threshold = config.memory_per_chip_mb * (1 << 20)
+    if threshold:
+        # calibrated predicted->actual memory correction (SURVEY §7 hard
+        # part 4): when the chip's XLA footprint runs `corr`x the
+        # simulator's prediction, the DP must aim for budget/corr so the
+        # ACTUAL bytes fit
+        mem_correction = _memory_correction()
+        if mem_correction > 1.0:
+            threshold /= mem_correction
     # mixed precision (TPU): activations + grads move in bf16 — halve the
     # collective payloads the cost model prices (matches the executor's
     # master-weight regime; CPU/f32 machines keep 1.0)
@@ -310,6 +319,7 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
     mesh_axes, strategy = decode_strategy(resp, new_nodes)
     info = dict(predicted_time=resp.get("predicted_time"),
                 predicted_memory=resp.get("predicted_memory"),
+                memory_correction=mem_correction,
                 stats=resp.get("stats", {}),
                 rewrites=resp.get("rewrites", []))
     if resp.get("pipeline") and mesh_axes.get("pipe", 1) > 1:
@@ -322,6 +332,27 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
         info["rewritten_nodes"] = new_nodes
         info["final_ref"] = new_final
     return mesh_axes, strategy, info
+
+
+def _memory_correction() -> float:
+    """Median actual/predicted memory ratio from CALIBRATION.json's
+    per-model `mem_ratio` rows (written by scripts/calibrate.py), 1.0
+    when no calibration exists. FFS_CALIBRATION_FILE overrides the path
+    (tests)."""
+    path = os.environ.get("FFS_CALIBRATION_FILE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "CALIBRATION.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 1.0
+    ratios = sorted(r["mem_ratio"] for r in data.get("results", [])
+                    if isinstance(r.get("mem_ratio"), (int, float))
+                    and r["mem_ratio"] > 0)
+    if not ratios:
+        return 1.0
+    return float(ratios[len(ratios) // 2])
 
 
 # ---- strategy files (--export-strategy / --import-strategy) ---------------

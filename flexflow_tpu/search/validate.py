@@ -87,10 +87,9 @@ def emitted_collectives(hlo_text: str, min_bytes: float = 1 << 12
     return dict(out)
 
 
-def train_step_hlo(ff) -> str:
-    """Lower + compile the model's train step; return optimized HLO text."""
+def compiled_train_step(ff):
+    """Lower + compile the model's jitted train step on the live mesh."""
     ex = ff.executor
-    bs = ff.input_tensors[0].shape[0]
     rs = np.random.RandomState(0)
     xs = []
     for t in ff.input_tensors:
@@ -105,7 +104,35 @@ def train_step_hlo(ff) -> str:
     step = ex.make_train_step()
     lowered = step.lower(ff.params, ff.opt_state, ff.state, inputs, labels,
                          jax.random.PRNGKey(0))
-    return lowered.compile().as_text()
+    return lowered.compile()
+
+
+def train_step_hlo(ff) -> str:
+    """Lower + compile the model's train step; return optimized HLO text."""
+    return compiled_train_step(ff).as_text()
+
+
+def predicted_vs_actual_memory(ff) -> Dict[str, float]:
+    """Search-predicted per-device memory vs XLA's compiled memory
+    analysis of the train step (SURVEY §7 hard-part 4 / VERDICT r4 #6).
+
+    `actual` counts live arguments (params + optimizer state + staged
+    batch, all resident for the step) plus XLA's temp allocation — the
+    per-device peak the HBM budget actually has to cover. Requires a
+    search-compiled model (compile with search_budget > 0) so
+    `search_info["predicted_memory"]` exists.
+    """
+    info = ff.search_info if isinstance(ff.search_info, dict) else {}
+    predicted = info.get("predicted_memory")
+    if not predicted:
+        raise ValueError(
+            "predicted_vs_actual_memory needs a search-compiled model "
+            "(set search_budget so predicted_memory is recorded)")
+    ma = compiled_train_step(ff).memory_analysis()
+    actual = float(getattr(ma, "argument_size_in_bytes", 0)
+                   + getattr(ma, "temp_size_in_bytes", 0))
+    return dict(predicted=float(predicted), actual=actual,
+                ratio=actual / float(predicted))
 
 
 def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:

@@ -95,8 +95,9 @@ def example_batch(ff, loss_kind):
     return xs, y
 
 
-def predicted_step_time(ff, measured):
-    """One-chip simulated iteration via the native taskgraph simulator."""
+def predicted_step(ff, measured):
+    """One-chip simulated iteration via the native taskgraph simulator.
+    Returns (iteration_time_s, predicted_memory_bytes)."""
     from flexflow_tpu.search.native import native_simulate
     from flexflow_tpu.search.unity import machine_to_json, serialize_graph
 
@@ -110,7 +111,18 @@ def predicted_step_time(ff, measured):
         assignment={str(n.op.guid): "rep" for n in nodes},
         measured=measured,
     )
-    return native_simulate(req)["iteration_time"]
+    resp = native_simulate(req)
+    return resp["iteration_time"], resp.get("memory", 0.0)
+
+
+def actual_step_memory(ff):
+    """XLA's compiled per-device footprint of the train step: live
+    arguments (params + opt state + staged batch) + temp allocation."""
+    from flexflow_tpu.search.validate import compiled_train_step
+
+    ma = compiled_train_step(ff).memory_analysis()
+    return float(getattr(ma, "argument_size_in_bytes", 0)
+                 + getattr(ma, "temp_size_in_bytes", 0))
 
 
 def actual_step_time(ff, xs, y, repeats=3):
@@ -167,22 +179,35 @@ def main():
         compile_model(ff, loss_kind)
         nodes = ff.executor.nodes
         measured = microbenchmark(nodes, cache_file=cache)
-        predicted = predicted_step_time(ff, measured)
+        predicted, predicted_mem = predicted_step(ff, measured)
         xs, y = example_batch(ff, loss_kind)
         actual = actual_step_time(ff, xs, y)
         ratio = predicted / actual if actual > 0 else float("inf")
+        # predicted-vs-actual MEMORY (SURVEY §7 hard part 4): the DP's
+        # threshold check applies the median mem_ratio as a correction
+        # (flexflow_tpu/search/unity.py _memory_correction)
+        try:
+            actual_mem = actual_step_memory(ff)
+        except Exception:
+            actual_mem = 0.0
+        mem_ratio = (actual_mem / predicted_mem
+                     if predicted_mem and actual_mem else None)
         results.append(dict(
             model=name,
             predicted_s=predicted,
             actual_s=actual,
             ratio=round(ratio, 4),
             within_tolerance=bool(abs(ratio - 1.0) <= TOLERANCE),
+            predicted_mem_bytes=predicted_mem,
+            actual_mem_bytes=actual_mem,
+            mem_ratio=round(mem_ratio, 4) if mem_ratio else None,
             ops_total=len(nodes),
             ops_measured=sum(1 for n in nodes
                              if f"{n.op.guid}:fwd" in measured),
         ))
         print(f"{name:12s} predicted {predicted * 1e3:8.3f} ms   "
-              f"actual {actual * 1e3:8.3f} ms   ratio {ratio:.3f}")
+              f"actual {actual * 1e3:8.3f} ms   ratio {ratio:.3f}   "
+              f"mem {mem_ratio if mem_ratio else 'n/a'}")
 
     platform = jax.devices()[0].platform
     out = dict(platform=platform,
