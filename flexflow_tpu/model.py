@@ -977,6 +977,20 @@ class FFModel:
                 overrides[layer.name] = tuple(shp)
         nodes, input_names, tensor_ref = self._materialize_nodes(overrides)
         final_ref = self._select_final_ref(nodes, tensor_ref)
+        # parameter shapes must be sequence-independent; a mismatch means
+        # dim 1 of some input was NOT the sequence (e.g. an auxiliary
+        # (B, S)-shaped feature input whose extent coincides) and slicing
+        # it would silently corrupt training — refuse instead
+        full_elems = {n.op.guid: n.op.params_elems()
+                      for n in self.executor.nodes}
+        for n in nodes:
+            if full_elems.get(n.op.guid, n.op.params_elems()) \
+                    != n.op.params_elems():
+                raise NotImplementedError(
+                    f"seq_length buckets: op '{n.op.name}' changes "
+                    f"parameter shape at the bucketed length — an input "
+                    f"whose dim 1 coincides with the sequence extent is "
+                    f"not actually a sequence; run full-length instead")
         apply_strategy(nodes, self.strategy, self.mesh)
         full = self.executor
         ex = GraphExecutor(nodes, input_names, final_ref, self.mesh,

@@ -41,10 +41,18 @@ def _global_batch(cfg):
     return x, y
 
 
-def _build_and_train(total_devices: int):
+def _tp_leg_possible(total_devices: int) -> bool:
+    """The {data: N/2, model: 2} mesh needs an even device count >= 4."""
+    return total_devices >= 4 and total_devices % 2 == 0
+
+
+def _build_and_train(total_devices: int, tensor_parallel: bool = False):
     """Train the dryrun model for _STEPS steps on this process's rows of
     the fixed global batch; returns the FFModel. Works single-process
-    (feeds the whole batch) and multi-process (feeds the local block)."""
+    (feeds the whole batch) and multi-process (feeds the local block).
+    tensor_parallel=True uses a {data: N/2, model: 2} mesh with
+    model-sharded weights — the model axis then SPANS hosts, exercising
+    cross-host psum/all-gather, not just the gradient ring."""
     import jax
 
     from flexflow_tpu.config import FFConfig
@@ -54,8 +62,14 @@ def _build_and_train(total_devices: int):
     from flexflow_tpu.optimizers import SGDOptimizer
 
     cfg = _model_config(total_devices)
-    ff = create_transformer(cfg, FFConfig(batch_size=cfg.batch_size))
-    mesh = make_mesh(total_devices, {"data": total_devices})
+    ff = create_transformer(
+        cfg, FFConfig(batch_size=cfg.batch_size,
+                      enable_parameter_parallel=tensor_parallel))
+    if tensor_parallel:
+        mesh = make_mesh(total_devices,
+                         {"data": total_devices // 2, "model": 2})
+    else:
+        mesh = make_mesh(total_devices, {"data": total_devices})
     ff.compile(SGDOptimizer(lr=0.05),
                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [], mesh=mesh)
     x, y = _global_batch(cfg)
@@ -67,6 +81,8 @@ def _build_and_train(total_devices: int):
 
 
 def _params_to_numpy(ff) -> Dict[str, np.ndarray]:
+    from flexflow_tpu import distributed
+
     flat: Dict[str, np.ndarray] = {}
 
     def rec(prefix, tree):
@@ -75,8 +91,9 @@ def _params_to_numpy(ff) -> Dict[str, np.ndarray]:
             if isinstance(v, dict):
                 rec(f"{prefix}{k}/", v)
             else:
-                # data-parallel params are replicated => fully addressable
-                flat[f"{prefix}{k}"] = np.asarray(v)
+                # model-sharded params may not be fully addressable on one
+                # host — gather (no-op single-process / replicated)
+                flat[f"{prefix}{k}"] = distributed.all_gather_host(v)
 
     rec("", ff.params)
     return flat
@@ -101,8 +118,15 @@ def worker_main(process_id: int, num_processes: int, port: int,
         f"expected {num_processes * devices_per_proc} global devices, "
         f"got {total}")
     ff = _build_and_train(total)
-    np.savez(out_path, loss=np.float64(ff._last_loss),
-             **_params_to_numpy(ff))
+    out = {"loss": np.float64(ff._last_loss)}
+    out.update({f"dp/{k}": v for k, v in _params_to_numpy(ff).items()})
+    if _tp_leg_possible(total):
+        # leg 2: tensor parallelism whose model axis spans the two hosts
+        ff_tp = _build_and_train(total, tensor_parallel=True)
+        out["tp_loss"] = np.float64(ff_tp._last_loss)
+        out.update({f"tp/{k}": v
+                    for k, v in _params_to_numpy(ff_tp).items()})
+    np.savez(out_path, **out)
 
 
 def _free_port() -> int:
@@ -159,30 +183,42 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
                 f"multihost dryrun: worker exit codes {rcs}")
         worker_results = [dict(np.load(o)) for o in outs]
 
-    # single-process reference on the same global batch
+    # single-process references on the same global batch
     if len(jax.devices()) < total:
         raise RuntimeError(
             f"multihost dryrun needs {total} local devices for the "
             f"reference leg, have {len(jax.devices())}")
-    ref = _build_and_train(total)
-    ref_params = _params_to_numpy(ref)
-    ref_loss = float(ref._last_loss)
+    legs = [("dp", False)] + ([("tp", True)] if _tp_leg_possible(total)
+                              else [])
+    refs = {}
+    for leg, tp in legs:
+        ref = _build_and_train(total, tensor_parallel=tp)
+        refs[leg] = (_params_to_numpy(ref), float(ref._last_loss))
 
     for p, got in enumerate(worker_results):
-        got_loss = float(got.pop("loss"))
-        if not np.isfinite(got_loss) or abs(got_loss - ref_loss) > 1e-4 * (
-                1.0 + abs(ref_loss)):
-            raise AssertionError(
-                f"worker {p} loss {got_loss} != reference {ref_loss}")
-        missing = set(ref_params) - set(got)
-        if missing:
-            raise AssertionError(f"worker {p} missing params: {missing}")
-        for k, rv in ref_params.items():
-            if not np.allclose(got[k], rv, rtol=1e-4, atol=1e-5):
-                diff = float(np.max(np.abs(got[k] - rv)))
+        for leg, loss_key in [("dp", "loss"), ("tp", "tp_loss")][:len(legs)]:
+            ref_params, ref_loss = refs[leg]
+            got_loss = float(got.pop(loss_key))
+            if not np.isfinite(got_loss) or abs(got_loss - ref_loss) > \
+                    1e-4 * (1.0 + abs(ref_loss)):
                 raise AssertionError(
-                    f"worker {p} param {k} diverged from single-process "
-                    f"reference (max abs diff {diff})")
+                    f"worker {p} {leg} loss {got_loss} != reference "
+                    f"{ref_loss}")
+            leg_params = {k[len(leg) + 1:]: v for k, v in got.items()
+                          if k.startswith(f"{leg}/")}
+            missing = set(ref_params) - set(leg_params)
+            if missing:
+                raise AssertionError(
+                    f"worker {p} {leg} missing params: {missing}")
+            for k, rv in ref_params.items():
+                if not np.allclose(leg_params[k], rv, rtol=1e-4,
+                                   atol=1e-5):
+                    diff = float(np.max(np.abs(leg_params[k] - rv)))
+                    raise AssertionError(
+                        f"worker {p} {leg} param {k} diverged from "
+                        f"single-process reference (max abs diff {diff})")
+    legs_txt = " AND cross-host tensor-parallel" if "tp" in refs else ""
+    losses = ", ".join(f"{leg} loss {refs[leg][1]:.6f}" for leg in refs)
     print(f"multihost dryrun ok: {num_processes} processes x "
-          f"{devices_per_proc} devices, gradient sync matches "
-          f"single-process (loss {ref_loss:.6f})")
+          f"{devices_per_proc} devices; data-parallel{legs_txt} "
+          f"match single-process ({losses})")

@@ -43,7 +43,9 @@ def _build(clean: bool = False) -> bool:
     except Exception:
         return False
     finally:
-        if backup is not None and not os.path.exists(_LIB_PATH):
+        # restore the known-good library on ANY failed build — including
+        # a killed compiler leaving a truncated .so behind
+        if backup is not None:
             os.replace(backup, _LIB_PATH)
 
 

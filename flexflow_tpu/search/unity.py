@@ -47,6 +47,11 @@ def _node_attrs(op) -> Dict[str, Any]:
         v = getattr(op, name, None)
         if isinstance(v, tuple) and len(v) == 2:
             attrs[keys[0]], attrs[keys[1]] = int(v[0]), int(v[1])
+    # explicit mesh-axis name of a Repartition (repartition(axis=...)) —
+    # mesh enumeration pins the NAMED axis, not the dim-derived default
+    mesh_axis = getattr(op, "axis", None)
+    if isinstance(mesh_axis, str):
+        attrs["mesh_axis"] = mesh_axis
     # BatchNorm's fused relu flag (PM_RELU in the substitution engine)
     relu = getattr(op, "relu", None)
     if isinstance(relu, bool):

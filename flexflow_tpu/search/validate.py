@@ -112,6 +112,16 @@ def train_step_hlo(ff) -> str:
     return compiled_train_step(ff).as_text()
 
 
+def compiled_footprint_bytes(compiled) -> float:
+    """Per-device peak the HBM budget must cover: live arguments
+    (params + optimizer state + staged batch, resident for the whole
+    step) plus XLA's temp allocation. Single definition shared by the
+    validator and scripts/calibrate.py."""
+    ma = compiled.memory_analysis()
+    return float(getattr(ma, "argument_size_in_bytes", 0)
+                 + getattr(ma, "temp_size_in_bytes", 0))
+
+
 def predicted_vs_actual_memory(ff) -> Dict[str, float]:
     """Search-predicted per-device memory vs XLA's compiled memory
     analysis of the train step (SURVEY §7 hard-part 4 / VERDICT r4 #6).
@@ -128,9 +138,7 @@ def predicted_vs_actual_memory(ff) -> Dict[str, float]:
         raise ValueError(
             "predicted_vs_actual_memory needs a search-compiled model "
             "(set search_budget so predicted_memory is recorded)")
-    ma = compiled_train_step(ff).memory_analysis()
-    actual = float(getattr(ma, "argument_size_in_bytes", 0)
-                   + getattr(ma, "temp_size_in_bytes", 0))
+    actual = compiled_footprint_bytes(compiled_train_step(ff))
     return dict(predicted=float(predicted), actual=actual,
                 ratio=actual / float(predicted))
 

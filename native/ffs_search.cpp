@@ -414,14 +414,38 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
                                         const PipelineMeta& pipe = {}) {
   int64_t seq_extent = 0;
   int64_t num_experts = 0;
+  // explicit REPARTITION ops pin an axis's extent: the Python applier
+  // rejects a mesh whose matching axis exists with extent != degree
+  // (parallel/strategy.py GSPMD legality — the check applies to
+  // standalone Repartition only; Combine/Reduction/FusedParallel lower
+  // without it), so such meshes must not be enumerated — the search
+  // would pick a plan the executor refuses
+  std::map<int8_t, std::set<int64_t>> pinned;  // axis -> required degrees
   for (const Node& n : g.nodes) {
     if (n.type == "EXPERTS")
       num_experts = std::max(num_experts, n.attrs.get("n_experts").as_int(0));
+    if (n.type == "REPARTITION") {
+      int64_t dim = n.attrs.get("dim").as_int(0);
+      int64_t deg = n.attrs.get("degree").as_int(1);
+      // the op may name its mesh axis explicitly (repartition(axis=...))
+      std::string ax_name = n.attrs.get("mesh_axis").as_string();
+      int8_t ax = ax_name == "data"     ? kData
+                  : ax_name == "model"  ? kModel
+                  : ax_name == "seq"    ? kSeq
+                  : ax_name == "expert" ? kExpert
+                  : (dim == 0 ? kData : kModel);
+      if (deg > 1) pinned[ax].insert(deg);
+    }
     if (n.roles.empty()) continue;
     for (size_t d = 0; d < n.roles[0].size(); ++d)
       if (n.roles[0][d] == Role::Seq && d < n.output_shapes[0].size())
         seq_extent = std::max(seq_extent, n.output_shapes[0][d]);
   }
+  auto axis_ok = [&](int8_t ax, int size) {
+    auto it = pinned.find(ax);
+    if (it == pinned.end() || size == 1) return true;
+    return it->second.count((int64_t)size) > 0;
+  };
   std::vector<MeshShape> meshes;
   int N = std::max(1, m.num_devices);
   for (int mp = 1; mp <= N; ++mp) {
@@ -453,6 +477,9 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
           // the host stages the batch sharded over 'data': dp must divide
           // it (under pipe: each microbatch shards over dp too)
           if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
+          if (!axis_ok(kData, dp) || !axis_ok(kModel, mp) ||
+              !axis_ok(kSeq, sp) || !axis_ok(kExpert, ep))
+            continue;
           // multislice: model/seq/expert collectives are latency-bound and
           // must stay inside one ICI domain; only the data (gradient) axis
           // and the point-to-point pipe hops may cross slices

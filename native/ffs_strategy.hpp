@@ -630,8 +630,43 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   }
   double flop = n.fwd_flops / div;
   double bytes = (double)n.total_io_bytes() / div;
+  // shape-aware MXU efficiency for matmul-carrying ops: derive (M,N,K)
+  // from the node's shapes, then shrink the dim the CHOICE shards —
+  // a col-parallel Linear runs an N/mp-wide matmul per chip, a
+  // dp-sharded one an M/dp-tall one. Measured costs override all this.
+  double eff = -1.0;
+  if (n.type == "LINEAR" || n.type == "CONV2D") {
+    double M = 0, N = 0, K = 0;
+    if (n.type == "LINEAR" && !n.input_shapes.empty() &&
+        !n.input_shapes[0].empty() && !n.output_shapes.empty()) {
+      const Shape& is = n.input_shapes[0];
+      K = (double)is.back();
+      M = 1;
+      for (size_t i = 0; i + 1 < is.size(); ++i) M *= (double)is[i];
+      N = (double)n.output_shapes[0].back();
+    } else if (n.type == "CONV2D") {
+      auto kit = n.params.find("kernel");  // OIHW
+      if (kit != n.params.end() && kit->second.size() == 4 &&
+          !n.output_shapes.empty() && n.output_shapes[0].size() == 4) {
+        const Shape& os = n.output_shapes[0];
+        N = (double)kit->second[0];
+        K = (double)(kit->second[1] * kit->second[2] * kit->second[3]);
+        M = (double)(os[0] * os[2] * os[3]);
+      }
+    }
+    if (M > 0 && N > 0 && K > 0) {
+      const std::string& cn = c.name;
+      if (cn.rfind("dp", 0) == 0) M /= mesh.dp;
+      if (cn.rfind("sample2", 0) == 0) M /= (double)mesh.dp * mesh.mp;
+      if (cn.find("col") != std::string::npos) N /= mesh.mp;
+      if (cn.find("row") != std::string::npos) K /= mesh.mp;
+      if (cn.size() > 3 && cn.compare(cn.size() - 3, 3, "_sp") == 0)
+        M /= mesh.sp;
+      eff = m.matmul_efficiency(M, N, K);
+    }
+  }
   nc.fwd = mfwd ? std::max(*mfwd / div, m.min_op_time)
-                : m.compute_time(flop, bytes, n.dtype_size);
+                : m.compute_time(flop, bytes, n.dtype_size, eff);
   if (training)
     nc.bwd = mbwd ? std::max(*mbwd / div, m.min_op_time)
                   : 2.0 * nc.fwd;  // dX + dW passes

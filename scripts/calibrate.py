@@ -116,13 +116,12 @@ def predicted_step(ff, measured):
 
 
 def actual_step_memory(ff):
-    """XLA's compiled per-device footprint of the train step: live
-    arguments (params + opt state + staged batch) + temp allocation."""
-    from flexflow_tpu.search.validate import compiled_train_step
+    """XLA's compiled per-device footprint of the train step (shared
+    definition: flexflow_tpu/search/validate.py)."""
+    from flexflow_tpu.search.validate import (compiled_footprint_bytes,
+                                              compiled_train_step)
 
-    ma = compiled_train_step(ff).memory_analysis()
-    return float(getattr(ma, "argument_size_in_bytes", 0)
-                 + getattr(ma, "temp_size_in_bytes", 0))
+    return compiled_footprint_bytes(compiled_train_step(ff))
 
 
 def actual_step_time(ff, xs, y, repeats=3):

@@ -92,3 +92,27 @@ class TestParallelOps:
         b = ff.get_parameter("d1", "bias")
         ref = (x @ k + b).reshape(16, 4, 8).sum(axis=1)
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestExplicitAxisPinning:
+    def test_named_axis_repartition_pins_searched_mesh(self):
+        """repartition(dim=0, degree=2, axis="model"): the search must
+        pin the NAMED mesh axis (not the dim-derived default) and only
+        enumerate meshes the strategy applier will accept (r5 review)."""
+        import numpy as np
+
+        from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+        ff = FFModel(FFConfig(batch_size=32, search_budget=2,
+                              enable_parameter_parallel=True))
+        t = ff.create_tensor((32, 16))
+        h = ff.dense(t, 64)
+        h = ff.repartition(h, dim=0, degree=2, axis="model")
+        ff.dense(h, 16)
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+        assert axes.get("model", 1) in (1, 2), axes
+        rs = np.random.RandomState(0)
+        ff.fit(rs.randn(32, 16).astype(np.float32),
+               rs.randn(32, 16).astype(np.float32), epochs=1, verbose=False)

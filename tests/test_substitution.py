@@ -802,9 +802,12 @@ class TestNewCorpusFamilyNumerics:
                   attrs={"dim": 1, "degree": 2}),
             _linear(4, "fc2", [3, 0], b, d, d),
         ]
+        # 4 devices: the pinned degree-2 axes ({data:2, model:2}) must
+        # factor the machine exactly (enumerate_meshes now refuses
+        # meshes the executor's degree==extent legality would reject)
         resp = native_optimize({
-            "machine": MACHINE, "measured": {}, "nodes": nodes,
-            "final": [4, 0],
+            "machine": dict(MACHINE, num_devices=4), "measured": {},
+            "nodes": nodes, "final": [4, 0],
             "config": _cfg(budget=3, rules=[], subst_budget=16),
             "subst_rules": [rule]})
         fired = [r["rule"] for r in resp.get("rewrites", [])]
