@@ -66,16 +66,25 @@ def _build_and_train(total_devices: int, tensor_parallel: bool = False):
         cfg, FFConfig(batch_size=cfg.batch_size,
                       enable_parameter_parallel=tensor_parallel))
     if tensor_parallel:
+        # model axis FIRST (outermost): its stride equals half the device
+        # list, so each model-ring pairs devices from DIFFERENT processes
+        # — the leg exercises cross-host psum/all-gather, not an
+        # intra-host copy of them. The data axis then lives within hosts
+        # and each host feeds the FULL batch (its devices hold every
+        # batch shard), which local_batch_rows resolves below.
         mesh = make_mesh(total_devices,
-                         {"data": total_devices // 2, "model": 2})
+                         {"model": 2, "data": total_devices // 2})
     else:
         mesh = make_mesh(total_devices, {"data": total_devices})
     ff.compile(SGDOptimizer(lr=0.05),
                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [], mesh=mesh)
     x, y = _global_batch(cfg)
-    pc, pi = jax.process_count(), jax.process_index()
-    rows = x.shape[0] // pc
-    lo = rows * pi
+    if jax.process_count() > 1:
+        from flexflow_tpu import distributed
+        rows, lo = distributed.local_batch_rows(
+            ff.executor.batch_sharding(), x.shape[0])
+    else:
+        rows, lo = x.shape[0], 0
     ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS, verbose=False)
     return ff
 

@@ -79,6 +79,16 @@ class Repartition(ParallelOpBase):
     def preferred_spec_update(self, entries):
         d = self.repartition_dim % len(self.output_shapes[0])
         entries = list(entries)
+        # a Repartition RE-lays-out the tensor: if the producer already
+        # used this mesh axis on another dim, that dim un-shards here
+        # (GSPMD inserts the implied reshard) — the constraint owns the
+        # axis, exactly like the reference's Repartition replacing the
+        # ParallelTensor's layout (src/parallel_ops/partition.cc)
+        for i, e in enumerate(entries):
+            axes = e if isinstance(e, tuple) else (e,)
+            if i != d and self.axis in axes:
+                entries[i] = (tuple(a for a in axes if a != self.axis)
+                              or None) if isinstance(e, tuple) else None
         entries[d] = self.axis
         return entries
 

@@ -56,11 +56,14 @@ def _node_attrs(op) -> Dict[str, Any]:
     relu = getattr(op, "relu", None)
     if isinstance(relu, bool):
         attrs["relu"] = int(relu)
-    # FusedParallelOp step chain
+    # FusedParallelOp step chain (4th element: the step's mesh-axis name,
+    # so the native cost model prices the axis the executor uses)
     fused = getattr(op, "fused_ops", None)
     if fused:
         attrs["ops"] = [[k.name if hasattr(k, "name") else str(k),
-                         int(d), int(g)] for (k, d, g, _a) in fused]
+                         int(d), int(g)] + ([a] if isinstance(a, str)
+                                            else [])
+                        for (k, d, g, a) in fused]
     # the substitution engine matches on these (PM_* keys, ffs_subst.hpp)
     act = getattr(op, "activation", None)
     if act is not None and hasattr(act, "value"):

@@ -442,9 +442,12 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
         seq_extent = std::max(seq_extent, n.output_shapes[0][d]);
   }
   auto axis_ok = [&](int8_t ax, int size) {
+    // the executor requires EVERY standalone Repartition's degree to
+    // equal its axis's extent, so an axis with conflicting pinned
+    // degrees is only legal at extent 1 (constraints unrealizable)
     auto it = pinned.find(ax);
     if (it == pinned.end() || size == 1) return true;
-    return it->second.count((int64_t)size) > 0;
+    return it->second.size() == 1 && *it->second.begin() == (int64_t)size;
   };
   std::vector<MeshShape> meshes;
   int N = std::max(1, m.num_devices);

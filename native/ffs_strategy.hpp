@@ -398,10 +398,17 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     // collective each boundary forces, so the substitution engine's
     // moves/eliminations of these nodes change the searched cost. The
     // degree must equal the mesh axis extent to be realizable (the Python
-    // strategy applier enforces the same for Repartition).
+    // strategy applier enforces the same for Repartition). A Repartition
+    // may NAME its mesh axis (repartition(axis=...), serialized as
+    // mesh_axis) — cost the axis the executor will actually use.
     int64_t dim = n.attrs.get("dim").as_int(0);
     int64_t deg = n.attrs.get("degree").as_int(1);
-    int8_t ax = dim == 0 ? kData : kModel;
+    std::string ax_name = n.attrs.get("mesh_axis").as_string();
+    int8_t ax = ax_name == "data"     ? kData
+                : ax_name == "model"  ? kModel
+                : ax_name == "seq"    ? kSeq
+                : ax_name == "expert" ? kExpert
+                : (dim == 0 ? kData : kModel);
     if (deg > 1 && mesh.axis_size(ax) == deg && orank > 0 &&
         dim < (int64_t)orank) {
       out.clear();
@@ -436,7 +443,14 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         std::string kind = st_[0].as_string();
         int64_t dim = st_[1].as_int(0);
         int64_t deg = st_[2].as_int(1);
-        int8_t ax = dim == 0 ? kData : kModel;
+        std::string axn = st_.items().size() > 3
+                              ? st_[3].as_string()
+                              : std::string();  // optional 4th element
+        int8_t ax = axn == "data"     ? kData
+                    : axn == "model"  ? kModel
+                    : axn == "seq"    ? kSeq
+                    : axn == "expert" ? kExpert
+                    : (dim == 0 ? kData : kModel);
         if (kind == "REPARTITION") {
           if (dim < 0 || dim >= (int64_t)orank ||
               mesh.axis_size(ax) != deg || oshp[dim] % deg) {
@@ -636,34 +650,38 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   // dp-sharded one an M/dp-tall one. Measured costs override all this.
   double eff = -1.0;
   if (n.type == "LINEAR" || n.type == "CONV2D") {
+    // per-chip (M, N, K) from the choice's STRUCTURED per-dim axis
+    // assignments (not its name, which would rot as choices grow): each
+    // sharded dim divides by its mesh-axis extent
+    auto dim_shards = [&](const std::vector<Spec>& specs, size_t ti,
+                          size_t di) -> double {
+      if (ti >= specs.size() || di >= specs[ti].size()) return 1.0;
+      int8_t e = specs[ti][di];
+      return e >= 0 ? (double)mesh.axis_size(e) : 1.0;
+    };
     double M = 0, N = 0, K = 0;
     if (n.type == "LINEAR" && !n.input_shapes.empty() &&
         !n.input_shapes[0].empty() && !n.output_shapes.empty()) {
       const Shape& is = n.input_shapes[0];
-      K = (double)is.back();
+      const Shape& os = n.output_shapes[0];
+      K = (double)is.back() / dim_shards(c.in, 0, is.size() - 1);
       M = 1;
-      for (size_t i = 0; i + 1 < is.size(); ++i) M *= (double)is[i];
-      N = (double)n.output_shapes[0].back();
+      for (size_t i = 0; i + 1 < os.size(); ++i)
+        M *= (double)os[i] / dim_shards(c.out, 0, i);
+      N = (double)os.back() / dim_shards(c.out, 0, os.size() - 1);
     } else if (n.type == "CONV2D") {
       auto kit = n.params.find("kernel");  // OIHW
       if (kit != n.params.end() && kit->second.size() == 4 &&
           !n.output_shapes.empty() && n.output_shapes[0].size() == 4) {
         const Shape& os = n.output_shapes[0];
-        N = (double)kit->second[0];
-        K = (double)(kit->second[1] * kit->second[2] * kit->second[3]);
-        M = (double)(os[0] * os[2] * os[3]);
+        N = (double)kit->second[0] / dim_shards(c.out, 0, 1);
+        K = (double)(kit->second[1] * kit->second[2] * kit->second[3]) /
+            dim_shards(c.in, 0, 1);
+        M = (double)os[0] / dim_shards(c.out, 0, 0) *
+            (double)(os[2] * os[3]);
       }
     }
-    if (M > 0 && N > 0 && K > 0) {
-      const std::string& cn = c.name;
-      if (cn.rfind("dp", 0) == 0) M /= mesh.dp;
-      if (cn.rfind("sample2", 0) == 0) M /= (double)mesh.dp * mesh.mp;
-      if (cn.find("col") != std::string::npos) N /= mesh.mp;
-      if (cn.find("row") != std::string::npos) K /= mesh.mp;
-      if (cn.size() > 3 && cn.compare(cn.size() - 3, 3, "_sp") == 0)
-        M /= mesh.sp;
-      eff = m.matmul_efficiency(M, N, K);
-    }
+    if (M > 0 && N > 0 && K > 0) eff = m.matmul_efficiency(M, N, K);
   }
   nc.fwd = mfwd ? std::max(*mfwd / div, m.min_op_time)
                 : m.compute_time(flop, bytes, n.dtype_size, eff);
