@@ -428,12 +428,7 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
       int64_t dim = n.attrs.get("dim").as_int(0);
       int64_t deg = n.attrs.get("degree").as_int(1);
       // the op may name its mesh axis explicitly (repartition(axis=...))
-      std::string ax_name = n.attrs.get("mesh_axis").as_string();
-      int8_t ax = ax_name == "data"     ? kData
-                  : ax_name == "model"  ? kModel
-                  : ax_name == "seq"    ? kSeq
-                  : ax_name == "expert" ? kExpert
-                  : (dim == 0 ? kData : kModel);
+      int8_t ax = axis_from_name(n.attrs.get("mesh_axis").as_string(), dim);
       if (deg > 1) pinned[ax].insert(deg);
     }
     if (n.roles.empty()) continue;

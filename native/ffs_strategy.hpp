@@ -72,6 +72,18 @@ struct MeshShape {
 
 inline Spec rep_spec(size_t rank) { return Spec(rank, kRep); }
 
+// Named mesh axis ("data"/"model"/"seq"/"expert", e.g. repartition(axis=...))
+// -> axis id; unrecognized/absent names fall back to the dim-derived
+// default (dim 0 = batch = data, else model). Single definition shared by
+// mesh pinning (ffs_search.cpp) and choice pricing below.
+inline int8_t axis_from_name(const std::string& name, int64_t dim) {
+  if (name == "data") return kData;
+  if (name == "model") return kModel;
+  if (name == "seq") return kSeq;
+  if (name == "expert") return kExpert;
+  return dim == 0 ? kData : kModel;
+}
+
 // How many ICI slices the data axis spans. Mesh legality (enumerate_meshes)
 // keeps model/seq/expert inside one slice — their latency-sensitive
 // collectives ride ICI — so only the gradient ring (data axis) crosses DCN.
@@ -403,12 +415,7 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     // mesh_axis) — cost the axis the executor will actually use.
     int64_t dim = n.attrs.get("dim").as_int(0);
     int64_t deg = n.attrs.get("degree").as_int(1);
-    std::string ax_name = n.attrs.get("mesh_axis").as_string();
-    int8_t ax = ax_name == "data"     ? kData
-                : ax_name == "model"  ? kModel
-                : ax_name == "seq"    ? kSeq
-                : ax_name == "expert" ? kExpert
-                : (dim == 0 ? kData : kModel);
+    int8_t ax = axis_from_name(n.attrs.get("mesh_axis").as_string(), dim);
     if (deg > 1 && mesh.axis_size(ax) == deg && orank > 0 &&
         dim < (int64_t)orank) {
       out.clear();
@@ -443,14 +450,10 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         std::string kind = st_[0].as_string();
         int64_t dim = st_[1].as_int(0);
         int64_t deg = st_[2].as_int(1);
-        std::string axn = st_.items().size() > 3
-                              ? st_[3].as_string()
-                              : std::string();  // optional 4th element
-        int8_t ax = axn == "data"     ? kData
-                    : axn == "model"  ? kModel
-                    : axn == "seq"    ? kSeq
-                    : axn == "expert" ? kExpert
-                    : (dim == 0 ? kData : kModel);
+        // optional 4th element: the step's mesh-axis name
+        int8_t ax = axis_from_name(
+            st_.items().size() > 3 ? st_[3].as_string() : std::string(),
+            dim);
         if (kind == "REPARTITION") {
           if (dim < 0 || dim >= (int64_t)orank ||
               mesh.axis_size(ax) != deg || oshp[dim] % deg) {
