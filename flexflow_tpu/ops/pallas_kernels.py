@@ -33,11 +33,14 @@ BLK_Q = 128  # rows of Q per grid step (MXU-aligned)
 
 
 def _fwd_blk(s: int) -> int:
-    """Q-block rows for the forward kernel: 256 amortizes the K/V panel
-    re-reads better once the sequence is long enough (measured on v5e at
-    the BERT shape S=512, D=64: 256 runs ~5% faster than 128; 512 is
-    slower — the score tile starts crowding VMEM)."""
-    return 256 if s >= 512 and s % 256 == 0 else BLK_Q
+    """Q-block rows for the forward kernel. 128 everywhere: a same-chip
+    A/B through the FULL bert train step measured 228.1 samples/s at 128
+    vs 222.3 at 256 (r5) — an isolated-kernel microbench had suggested
+    256, but in the fused step the larger block loses (and a 256-block
+    forward feeding the single-block backward triggers a pathological
+    relayout in standalone use). Keep the block parameterized so the
+    experiment stays one-line."""
+    return BLK_Q
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
