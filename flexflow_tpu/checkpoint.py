@@ -59,7 +59,13 @@ def _rebuild(skel, flat: Dict[str, Any], prefix=""):
 
 
 def save_checkpoint(path: str, ffmodel) -> None:
-    """Write params + optimizer state + op state + iteration counter."""
+    """Write params + optimizer state + op state + iteration counter.
+
+    Multi-host: every process participates in gathering sharded leaves
+    (a collective), only process 0 writes the files, and every process
+    returns only after the files are durable (barrier) — the standard
+    multi-controller checkpoint discipline."""
+    from flexflow_tpu import distributed
     from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
     state = {
         "params": ffmodel.params,
@@ -70,11 +76,15 @@ def save_checkpoint(path: str, ffmodel) -> None:
                      if k != COMPUTE_PARAMS_KEY},
     }
     flat = _flatten(state)
+    multihost = jax.process_count() > 1
     arrays = {}
     scalars = {}
     for k, v in flat:
         if hasattr(v, "shape"):
-            arr = np.asarray(v)
+            # cross-host shards are not host-readable directly — gather
+            # (no-op single-process)
+            arr = (distributed.all_gather_host(v) if multihost
+                   else np.asarray(v))
             if arr.dtype.kind not in "fiub":
                 # np.savez writes non-native dtypes (ml_dtypes bfloat16)
                 # as raw void bytes that cannot load back — store as f32;
@@ -83,17 +93,25 @@ def save_checkpoint(path: str, ffmodel) -> None:
             arrays[k] = arr
         else:
             scalars[k] = v
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
-    manifest = {
-        "version": 1,
-        "iteration": ffmodel._iter,
-        "structure": _structure(state),
-        "scalars": scalars,
-        "array_keys": sorted(arrays),
-    }
-    with open(_manifest_path(path), "w") as f:
-        json.dump(manifest, f)
+    if not multihost or distributed.process_index() == 0:
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+        manifest = {
+            "version": 1,
+            "iteration": ffmodel._iter,
+            "structure": _structure(state),
+            "scalars": scalars,
+            "array_keys": sorted(arrays),
+        }
+        with open(_manifest_path(path), "w") as f:
+            json.dump(manifest, f)
+    if multihost:
+        # no rank may observe save_checkpoint as complete before the
+        # files are durable (a preemption handler or an immediate load
+        # on another rank must find a whole checkpoint)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ffs_checkpoint_written")
 
 
 def _manifest_path(path: str) -> str:
@@ -138,6 +156,17 @@ def load_checkpoint(path: str, ffmodel) -> int:
                     f"checkpoint shape {np.shape(new)} != live {live.shape}")
             # cast to the live dtype (bf16 opt state is saved as f32)
             import jax.numpy as jnp
+            if jax.process_count() > 1:
+                # every host loads the full array; each places only its
+                # addressable shards of the (possibly cross-host)
+                # sharding. The callback returns numpy so JAX places
+                # each shard directly on its device (ml_dtypes covers
+                # bf16), with no default-device detour
+                arr = np.asarray(new)
+                dtype = np.dtype(live.dtype)
+                return jax.make_array_from_callback(
+                    tuple(live.shape), live.sharding,
+                    lambda idx: arr[idx].astype(dtype))
             return jax.device_put(jnp.asarray(new, live.dtype), live.sharding)
         return new
 

@@ -46,15 +46,9 @@ def _tp_leg_possible(total_devices: int) -> bool:
     return total_devices >= 4 and total_devices % 2 == 0
 
 
-def _build_and_train(total_devices: int, tensor_parallel: bool = False):
-    """Train the dryrun model for _STEPS steps on this process's rows of
-    the fixed global batch; returns the FFModel. Works single-process
-    (feeds the whole batch) and multi-process (feeds the local block).
-    tensor_parallel=True uses a {data: N/2, model: 2} mesh with
-    model-sharded weights — the model axis then SPANS hosts, exercising
-    cross-host psum/all-gather, not just the gradient ring."""
-    import jax
-
+def _build(total_devices: int, tensor_parallel: bool = False):
+    """Compile the dryrun model (no training). tensor_parallel=True uses
+    a {model: 2, data: N/2} mesh whose model axis SPANS hosts."""
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.ffconst import LossType
     from flexflow_tpu.machine import make_mesh
@@ -78,6 +72,18 @@ def _build_and_train(total_devices: int, tensor_parallel: bool = False):
         mesh = make_mesh(total_devices, {"data": total_devices})
     ff.compile(SGDOptimizer(lr=0.05),
                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [], mesh=mesh)
+    return ff
+
+
+def _build_and_train(total_devices: int, tensor_parallel: bool = False):
+    """Compile + train the dryrun model for _STEPS steps on this
+    process's rows of the fixed global batch; returns the FFModel. Works
+    single-process (feeds the whole batch) and multi-process (feeds the
+    local block)."""
+    import jax
+
+    ff = _build(total_devices, tensor_parallel)
+    cfg = _model_config(total_devices)
     x, y = _global_batch(cfg)
     if jax.process_count() > 1:
         from flexflow_tpu import distributed
@@ -85,7 +91,16 @@ def _build_and_train(total_devices: int, tensor_parallel: bool = False):
             ff.executor.batch_sharding(), x.shape[0])
     else:
         rows, lo = x.shape[0], 0
-    ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS, verbose=False)
+    if tensor_parallel:
+        ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS,
+               verbose=False)
+    else:
+        # DP leg drives the DataLoader path (SingleDataLoader's
+        # multi-host staging), the TP leg drives fit() — both per-host
+        # feeding mechanisms get parity coverage
+        from flexflow_tpu.dataloader import create_data_loaders
+        loaders = create_data_loaders(ff, x[lo:lo + rows], y[lo:lo + rows])
+        ff.fit_loader(loaders, epochs=_STEPS, verbose=False)
     return ff
 
 
@@ -133,8 +148,24 @@ def worker_main(process_id: int, num_processes: int, port: int,
         # leg 2: tensor parallelism whose model axis spans the two hosts
         ff_tp = _build_and_train(total, tensor_parallel=True)
         out["tp_loss"] = np.float64(ff_tp._last_loss)
-        out.update({f"tp/{k}": v
-                    for k, v in _params_to_numpy(ff_tp).items()})
+        tp_params = _params_to_numpy(ff_tp)
+        out.update({f"tp/{k}": v for k, v in tp_params.items()})
+        # leg 3: cross-host checkpoint roundtrip of the model-sharded
+        # state — rank 0 writes (after an all-host gather), every host
+        # loads back onto the cross-host shardings
+        ckpt = os.path.join(os.path.dirname(out_path), "ckpt_tp")
+        ff_tp.save_checkpoint(ckpt)  # barriers internally: durable on return
+        ff_rt = _build(total, tensor_parallel=True)
+        ff_rt.load_checkpoint(ckpt)
+        rt_params = _params_to_numpy(ff_rt)
+        for key, want in tp_params.items():
+            got = rt_params[key]
+            # bf16 leaves round-trip through an f32 container
+            if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
+                raise AssertionError(
+                    f"checkpoint roundtrip diverged at {key}: max diff "
+                    f"{float(np.max(np.abs(got - want)))}")
+        out["ckpt_roundtrip_ok"] = np.float64(1.0)
     np.savez(out_path, **out)
 
 
@@ -226,6 +257,9 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
                     raise AssertionError(
                         f"worker {p} {leg} param {k} diverged from "
                         f"single-process reference (max abs diff {diff})")
+        if "tp" in refs and "ckpt_roundtrip_ok" not in got:
+            raise AssertionError(
+                f"worker {p} skipped the cross-host checkpoint roundtrip")
     legs_txt = " AND cross-host tensor-parallel" if "tp" in refs else ""
     losses = ", ".join(f"{leg} loss {refs[leg][1]:.6f}" for leg in refs)
     print(f"multihost dryrun ok: {num_processes} processes x "
