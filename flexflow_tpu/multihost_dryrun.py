@@ -41,14 +41,20 @@ def _global_batch(cfg):
     return x, y
 
 
-def _tp_leg_possible(total_devices: int) -> bool:
-    """The {data: N/2, model: 2} mesh needs an even device count >= 4."""
+def _multi_axis_legs_possible(total_devices: int) -> bool:
+    """Gates the tp AND ring legs plus the checkpoint roundtrip: their
+    {model|seq: 2, data: N/2} meshes need an even device count >= 4."""
     return total_devices >= 4 and total_devices % 2 == 0
 
 
-def _build(total_devices: int, tensor_parallel: bool = False):
-    """Compile the dryrun model (no training). tensor_parallel=True uses
-    a {model: 2, data: N/2} mesh whose model axis SPANS hosts."""
+def _build(total_devices: int, leg: str = "dp"):
+    """Compile the dryrun model (no training).
+
+    Legs: "dp" — pure data parallel; "tp" — a {model: 2, data: N/2} mesh
+    whose model axis SPANS hosts; "ring" — a {seq: 2, data: N/2} mesh
+    whose seq axis spans hosts, so ring attention's K/V ppermute hops
+    cross processes (long-context parallelism over the cross-host
+    fabric, the brief's first-class requirement)."""
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.ffconst import LossType
     from flexflow_tpu.machine import make_mesh
@@ -56,10 +62,13 @@ def _build(total_devices: int, tensor_parallel: bool = False):
     from flexflow_tpu.optimizers import SGDOptimizer
 
     cfg = _model_config(total_devices)
+    if leg == "ring":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, seq_parallel="seq")
     ff = create_transformer(
         cfg, FFConfig(batch_size=cfg.batch_size,
-                      enable_parameter_parallel=tensor_parallel))
-    if tensor_parallel:
+                      enable_parameter_parallel=(leg == "tp")))
+    if leg == "tp":
         # model axis FIRST (outermost): its stride equals half the device
         # list, so each model-ring pairs devices from DIFFERENT processes
         # — the leg exercises cross-host psum/all-gather, not an
@@ -68,6 +77,11 @@ def _build(total_devices: int, tensor_parallel: bool = False):
         # batch shard), which local_batch_rows resolves below.
         mesh = make_mesh(total_devices,
                          {"model": 2, "data": total_devices // 2})
+    elif leg == "ring":
+        # seq axis outermost for the same reason: every K/V rotation hop
+        # crosses processes
+        mesh = make_mesh(total_devices,
+                         {"seq": 2, "data": total_devices // 2})
     else:
         mesh = make_mesh(total_devices, {"data": total_devices})
     ff.compile(SGDOptimizer(lr=0.05),
@@ -75,14 +89,14 @@ def _build(total_devices: int, tensor_parallel: bool = False):
     return ff
 
 
-def _build_and_train(total_devices: int, tensor_parallel: bool = False):
+def _build_and_train(total_devices: int, leg: str = "dp"):
     """Compile + train the dryrun model for _STEPS steps on this
     process's rows of the fixed global batch; returns the FFModel. Works
     single-process (feeds the whole batch) and multi-process (feeds the
     local block)."""
     import jax
 
-    ff = _build(total_devices, tensor_parallel)
+    ff = _build(total_devices, leg)
     cfg = _model_config(total_devices)
     x, y = _global_batch(cfg)
     if jax.process_count() > 1:
@@ -91,16 +105,16 @@ def _build_and_train(total_devices: int, tensor_parallel: bool = False):
             ff.executor.batch_sharding(), x.shape[0])
     else:
         rows, lo = x.shape[0], 0
-    if tensor_parallel:
-        ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS,
-               verbose=False)
-    else:
+    if leg == "dp":
         # DP leg drives the DataLoader path (SingleDataLoader's
-        # multi-host staging), the TP leg drives fit() — both per-host
+        # multi-host staging), the other legs drive fit() — both per-host
         # feeding mechanisms get parity coverage
         from flexflow_tpu.dataloader import create_data_loaders
         loaders = create_data_loaders(ff, x[lo:lo + rows], y[lo:lo + rows])
         ff.fit_loader(loaders, epochs=_STEPS, verbose=False)
+    else:
+        ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS,
+               verbose=False)
     return ff
 
 
@@ -144,18 +158,24 @@ def worker_main(process_id: int, num_processes: int, port: int,
     ff = _build_and_train(total)
     out = {"loss": np.float64(ff._last_loss)}
     out.update({f"dp/{k}": v for k, v in _params_to_numpy(ff).items()})
-    if _tp_leg_possible(total):
+    if _multi_axis_legs_possible(total):
         # leg 2: tensor parallelism whose model axis spans the two hosts
-        ff_tp = _build_and_train(total, tensor_parallel=True)
+        ff_tp = _build_and_train(total, leg="tp")
         out["tp_loss"] = np.float64(ff_tp._last_loss)
         tp_params = _params_to_numpy(ff_tp)
         out.update({f"tp/{k}": v for k, v in tp_params.items()})
-        # leg 3: cross-host checkpoint roundtrip of the model-sharded
+        # leg 3: ring attention whose seq axis spans the two hosts —
+        # every K/V rotation hop is a cross-process ppermute
+        ff_ring = _build_and_train(total, leg="ring")
+        out["ring_loss"] = np.float64(ff_ring._last_loss)
+        out.update({f"ring/{k}": v
+                    for k, v in _params_to_numpy(ff_ring).items()})
+        # leg 4: cross-host checkpoint roundtrip of the model-sharded
         # state — rank 0 writes (after an all-host gather), every host
         # loads back onto the cross-host shardings
         ckpt = os.path.join(os.path.dirname(out_path), "ckpt_tp")
         ff_tp.save_checkpoint(ckpt)  # barriers internally: durable on return
-        ff_rt = _build(total, tensor_parallel=True)
+        ff_rt = _build(total, leg="tp")
         ff_rt.load_checkpoint(ckpt)
         rt_params = _params_to_numpy(ff_rt)
         for key, want in tp_params.items():
@@ -228,15 +248,16 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
         raise RuntimeError(
             f"multihost dryrun needs {total} local devices for the "
             f"reference leg, have {len(jax.devices())}")
-    legs = [("dp", False)] + ([("tp", True)] if _tp_leg_possible(total)
-                              else [])
+    legs = ["dp"] + (["tp", "ring"] if _multi_axis_legs_possible(total) else [])
     refs = {}
-    for leg, tp in legs:
-        ref = _build_and_train(total, tensor_parallel=tp)
+    for leg in legs:
+        ref = _build_and_train(total, leg=leg)
         refs[leg] = (_params_to_numpy(ref), float(ref._last_loss))
 
+    loss_keys = {"dp": "loss", "tp": "tp_loss", "ring": "ring_loss"}
     for p, got in enumerate(worker_results):
-        for leg, loss_key in [("dp", "loss"), ("tp", "tp_loss")][:len(legs)]:
+        for leg in legs:
+            loss_key = loss_keys[leg]
             ref_params, ref_loss = refs[leg]
             got_loss = float(got.pop(loss_key))
             if not np.isfinite(got_loss) or abs(got_loss - ref_loss) > \
@@ -260,8 +281,12 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
         if "tp" in refs and "ckpt_roundtrip_ok" not in got:
             raise AssertionError(
                 f"worker {p} skipped the cross-host checkpoint roundtrip")
-    legs_txt = " AND cross-host tensor-parallel" if "tp" in refs else ""
+    names = {"dp": "data-parallel", "tp": "cross-host tensor-parallel",
+             "ring": "cross-host ring attention"}
+    legs_txt = " + ".join(names[leg] for leg in refs)
+    if "tp" in refs:
+        legs_txt += " + checkpoint roundtrip"
     losses = ", ".join(f"{leg} loss {refs[leg][1]:.6f}" for leg in refs)
     print(f"multihost dryrun ok: {num_processes} processes x "
-          f"{devices_per_proc} devices; data-parallel{legs_txt} "
+          f"{devices_per_proc} devices; {legs_txt} "
           f"match single-process ({losses})")
