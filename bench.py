@@ -209,7 +209,11 @@ def ratchet(hist, key, samples_per_s, config, protocol):
     ONLY — never the config dict (a schema change must not reset the
     ratchet; r2 lesson). `protocol` records the actual windows x iters
     measured (e.g. "best3x30") so a drifted protocol is flagged, not
-    silently compared. Returns (vs_baseline, old_protocol_or_None)."""
+    silently compared. Returns (vs_baseline, best_ever,
+    old_protocol_or_None) — best_ever is reported beside each run's
+    number because the tunneled chip swings up to ~2.3x run-to-run
+    (BENCH_NOTES.md): a sub-1 vs_baseline on one run is usually chip
+    weather, and the framework's demonstrated capability is the best."""
     entry = hist.get(key) or {}
     baseline = entry.get("samples_per_s")
     vs = samples_per_s / baseline if baseline else 1.0
@@ -218,7 +222,8 @@ def ratchet(hist, key, samples_per_s, config, protocol):
         hist[key] = {"samples_per_s": samples_per_s, "protocol": protocol,
                      "config": config}
     # else: keep the stored best AND its provenance untouched
-    return vs, (old if old != protocol else None)
+    return vs, max(samples_per_s, baseline or 0.0), \
+        (old if old != protocol else None)
 
 
 def main():
@@ -249,18 +254,20 @@ def main():
             ff = None
             workloads_out[name] = {"error": f"{type(e).__name__}: {e}"}
             continue
-        vs, old_protocol = ratchet(hist, f"{name}:{platform}", sps,
-                                   cfg_dict, protocol)
+        vs, best, old_protocol = ratchet(hist, f"{name}:{platform}", sps,
+                                         cfg_dict, protocol)
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",
                 "value": round(sps, 3),
                 "unit": "samples/s",
                 "vs_baseline": round(vs, 4),
+                "best_recorded": round(best, 3),
             })
         else:
             workloads_out[name] = {"value": round(sps, 3),
-                                   "vs_baseline": round(vs, 4)}
+                                   "vs_baseline": round(vs, 4),
+                                   "best_recorded": round(best, 3)}
         if old_protocol:
             protocol_notes.append(f"{name}: {old_protocol} -> {protocol}")
         del ff
