@@ -91,9 +91,9 @@ def _build(total_devices: int, leg: str = "dp"):
 
 def _build_and_train(total_devices: int, leg: str = "dp"):
     """Compile + train the dryrun model for _STEPS steps on this
-    process's rows of the fixed global batch; returns the FFModel. Works
-    single-process (feeds the whole batch) and multi-process (feeds the
-    local block)."""
+    process's rows of the fixed global batch. Returns
+    (FFModel, local_x, local_y) — the local slice is derived ONCE here
+    and reused by callers (evaluate/predict legs)."""
     import jax
 
     ff = _build(total_devices, leg)
@@ -105,17 +105,17 @@ def _build_and_train(total_devices: int, leg: str = "dp"):
             ff.executor.batch_sharding(), x.shape[0])
     else:
         rows, lo = x.shape[0], 0
+    lx, ly = x[lo:lo + rows], y[lo:lo + rows]
     if leg == "dp":
         # DP leg drives the DataLoader path (SingleDataLoader's
         # multi-host staging), the other legs drive fit() — both per-host
         # feeding mechanisms get parity coverage
         from flexflow_tpu.dataloader import create_data_loaders
-        loaders = create_data_loaders(ff, x[lo:lo + rows], y[lo:lo + rows])
+        loaders = create_data_loaders(ff, lx, ly)
         ff.fit_loader(loaders, epochs=_STEPS, verbose=False)
     else:
-        ff.fit(x[lo:lo + rows], y[lo:lo + rows], epochs=_STEPS,
-               verbose=False)
-    return ff
+        ff.fit(lx, ly, epochs=_STEPS, verbose=False)
+    return ff, lx, ly
 
 
 def _params_to_numpy(ff) -> Dict[str, np.ndarray]:
@@ -155,18 +155,22 @@ def worker_main(process_id: int, num_processes: int, port: int,
     assert total == num_processes * devices_per_proc, (
         f"expected {num_processes * devices_per_proc} global devices, "
         f"got {total}")
-    ff = _build_and_train(total)
+    ff, lx, ly = _build_and_train(total)
     out = {"loss": np.float64(ff._last_loss)}
     out.update({f"dp/{k}": v for k, v in _params_to_numpy(ff).items()})
+    # evaluate + predict on the multi-host path: evaluate consumes local
+    # rows; predict gathers the GLOBAL output back to every host
+    out["eval_loss"] = np.float64(ff.evaluate(lx, ly)["loss"])
+    out["predict"] = ff.predict(lx)
     if _multi_axis_legs_possible(total):
         # leg 2: tensor parallelism whose model axis spans the two hosts
-        ff_tp = _build_and_train(total, leg="tp")
+        ff_tp, _, _ = _build_and_train(total, leg="tp")
         out["tp_loss"] = np.float64(ff_tp._last_loss)
         tp_params = _params_to_numpy(ff_tp)
         out.update({f"tp/{k}": v for k, v in tp_params.items()})
         # leg 3: ring attention whose seq axis spans the two hosts —
         # every K/V rotation hop is a cross-process ppermute
-        ff_ring = _build_and_train(total, leg="ring")
+        ff_ring, _, _ = _build_and_train(total, leg="ring")
         out["ring_loss"] = np.float64(ff_ring._last_loss)
         out.update({f"ring/{k}": v
                     for k, v in _params_to_numpy(ff_ring).items()})
@@ -250,8 +254,12 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
             f"reference leg, have {len(jax.devices())}")
     legs = ["dp"] + (["tp", "ring"] if _multi_axis_legs_possible(total) else [])
     refs = {}
+    dp_extra = {}
     for leg in legs:
-        ref = _build_and_train(total, leg=leg)
+        ref, rx, ry = _build_and_train(total, leg=leg)
+        if leg == "dp":
+            dp_extra["eval_loss"] = float(ref.evaluate(rx, ry)["loss"])
+            dp_extra["predict"] = ref.predict(rx)
         refs[leg] = (_params_to_numpy(ref), float(ref._last_loss))
 
     loss_keys = {"dp": "loss", "tp": "tp_loss", "ring": "ring_loss"}
@@ -281,6 +289,15 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
         if "tp" in refs and "ckpt_roundtrip_ok" not in got:
             raise AssertionError(
                 f"worker {p} skipped the cross-host checkpoint roundtrip")
+        # evaluate/predict parity vs the single-process reference
+        if abs(float(got["eval_loss"]) - dp_extra["eval_loss"]) > 1e-4 * (
+                1.0 + abs(dp_extra["eval_loss"])):
+            raise AssertionError(
+                f"worker {p} evaluate loss {float(got['eval_loss'])} != "
+                f"reference {dp_extra['eval_loss']}")
+        if not np.allclose(got["predict"], dp_extra["predict"], rtol=1e-4,
+                           atol=1e-5):
+            raise AssertionError(f"worker {p} predict diverged")
     names = {"dp": "data-parallel", "tp": "cross-host tensor-parallel",
              "ring": "cross-host ring attention"}
     legs_txt = " + ".join(names[leg] for leg in refs)
