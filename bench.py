@@ -26,7 +26,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def time_train(ff, xs, y, iters, windows):
+def time_train(ff, xs, y, iters, windows, tracer=None):
     """Steady-state training samples/s: jitted fwd+bwd+update loop.
 
     Plain per-step dispatch, NOT lax.scan — measured r3 (30 iters, v5e):
@@ -35,6 +35,12 @@ def time_train(ff, xs, y, iters, windows):
     the faster one. float(loss) forces a device->host sync — on the
     tunneled TPU backend block_until_ready alone does not. Best-of-N
     windows because the tunnel occasionally stalls for hundreds of ms.
+
+    ``tracer`` (an active obs StepTracer) wraps each step in a span
+    WITHOUT per-step fencing — the protocol's async pipelining is the
+    thing being measured, so spans record dispatch cadence, and the
+    window's host fetch is the only sync. None (the default) leaves the
+    loop untouched.
     """
     import jax.random as jrandom
 
@@ -47,6 +53,14 @@ def time_train(ff, xs, y, iters, windows):
         params, opt_state, state, loss, _ = train_step(
             params, opt_state, state, inputs, labels, sub)
         return params, opt_state, state, rng, loss
+
+    if tracer is not None and tracer.active:
+        _raw_step = step
+
+        def step(params, opt_state, state, rng):
+            with tracer.step():
+                with tracer.phase("dispatch"):
+                    return _raw_step(params, opt_state, state, rng)
 
     params, opt_state, state = ff.params, ff.opt_state, ff.state
     rng = jrandom.PRNGKey(0)
@@ -204,6 +218,13 @@ def load_history():
     return path, hist
 
 
+def save_history(path, hist):
+    """Atomic write-temp-then-rename: a bench crash mid-dump must never
+    truncate the ratchet history every later round compares against."""
+    from flexflow_tpu.obs.artifacts import atomic_write_text
+    atomic_write_text(path, json.dumps(hist))
+
+
 def ratchet(hist, key, samples_per_s, config, protocol):
     """Best-ever per workload key. The key is protocol name + platform
     ONLY — never the config dict (a schema change must not reset the
@@ -214,7 +235,12 @@ def ratchet(hist, key, samples_per_s, config, protocol):
     number because the tunneled chip swings up to ~2.3x run-to-run
     (BENCH_NOTES.md): a sub-1 vs_baseline on one run is usually chip
     weather, and the framework's demonstrated capability is the best."""
-    entry = hist.get(key) or {}
+    entry = hist.get(key)
+    if not isinstance(entry, dict):
+        # first run of a new workload family (key absent), or a legacy /
+        # hand-edited bare-number entry: both must ratchet cleanly
+        entry = ({"samples_per_s": float(entry)}
+                 if isinstance(entry, (int, float)) else {})
     baseline = entry.get("samples_per_s")
     vs = samples_per_s / baseline if baseline else 1.0
     old = entry.get("protocol", protocol) if entry else protocol
@@ -226,6 +252,27 @@ def ratchet(hist, key, samples_per_s, config, protocol):
         (old if old != protocol else None)
 
 
+def emit_obs_artifacts(name, ff, tracer):
+    """Per-workload observability emission (only when --trace-dir is
+    set): export the step trace, write the compiled-step summary
+    artifact, and print ONE census line — to stderr, because the driver
+    parses stdout as the single bench JSON line."""
+    import traceback
+
+    try:
+        from flexflow_tpu.obs import export_step_summary
+        tracer.export()
+        summary = export_step_summary(ff, tracer)
+        census = summary.get("collectives") or {}
+        total = summary.get("collectives_total") or {}
+        print(f"[obs] {name} collectives: "
+              + json.dumps(dict(per_kind=census, total=total)),
+              file=sys.stderr)
+    except Exception:
+        print(f"[obs] {name}: artifact emission failed:\n"
+              + traceback.format_exc(), file=sys.stderr)
+
+
 def main():
     import jax
 
@@ -233,6 +280,14 @@ def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     platform = "cpu" if on_cpu else "tpu"
     hist_path, hist = load_history()
+    trace_dir = os.environ.get("FFS_TRACE_DIR") or None
+    if "--trace-dir" in sys.argv:
+        i = sys.argv.index("--trace-dir")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
+            print("bench.py: --trace-dir requires a directory argument",
+                  file=sys.stderr)
+            sys.exit(2)
+        trace_dir = sys.argv[i + 1]
 
     result = {}
     workloads_out = {}
@@ -242,9 +297,16 @@ def main():
         windows = 1 if on_cpu else 3
         protocol = f"best{windows}x{iters}"
         ff = None
+        tracer = None
         try:
             ff, xs, y, cfg_dict = build(on_cpu)
-            sps = time_train(ff, xs, y, iters=iters, windows=windows)
+            if trace_dir:
+                from flexflow_tpu.obs import make_tracer
+                tracer = make_tracer(trace_dir, run_name=name)
+            sps = time_train(ff, xs, y, iters=iters, windows=windows,
+                             tracer=tracer)
+            if tracer is not None and tracer.active:
+                emit_obs_artifacts(name, ff, tracer)
         except Exception as e:
             if name == "bert_proxy":
                 raise  # the headline metric must never be silently absent
@@ -272,7 +334,7 @@ def main():
             protocol_notes.append(f"{name}: {old_protocol} -> {protocol}")
         del ff
     try:
-        json.dump(hist, open(hist_path, "w"))
+        save_history(hist_path, hist)
     except Exception:
         pass
     result["workloads"] = workloads_out

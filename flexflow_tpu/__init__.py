@@ -16,6 +16,11 @@ Layer map (cf. reference SURVEY.md §1):
   L5 auto-parallel  -> flexflow_tpu/search (PCG, substitutions, simulator)
   L6/L7 frontends   -> flexflow_tpu/keras, torch_frontend, onnx_frontend
   L9 models         -> flexflow_tpu/models
+  observability     -> flexflow_tpu/obs (step tracing, HLO cost/collective
+                       census, search-drift calibration; --trace-dir)
+
+``__version__`` (from flexflow_tpu/version.py) is stamped into every
+trace/census/drift artifact header the obs subsystem writes.
 """
 
 from flexflow_tpu.version import __version__

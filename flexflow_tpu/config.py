@@ -75,6 +75,12 @@ class FFConfig:
     perform_fusion: bool = True
     profiling: bool = False
     allow_mixed_precision: bool = True  # bf16 matmuls, f32 accumulate/params
+    # runtime observability (flexflow_tpu/obs): when set, fit/evaluate
+    # write per-step Chrome-trace/JSONL artifacts, a compiled-step
+    # summary (XLA cost/memory analysis + collective census), and a
+    # search-drift calibration report into this directory. None = the
+    # tracer is a shared no-op and the hot path pays nothing.
+    trace_dir: Optional[str] = None
 
     @property
     def num_devices(self) -> int:
@@ -180,6 +186,8 @@ class FFConfig:
                 self.perform_fusion = False
             elif a == "--profiling":
                 self.profiling = True
+            elif a == "--trace-dir":
+                self.trace_dir = take()
             else:
                 rest.append(a)
             i += 1

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from flexflow_tpu.ffconst import CompMode, LossType, OperatorType
 from flexflow_tpu.losses import get_loss_fn
 from flexflow_tpu.metrics import Metrics
+from flexflow_tpu.obs.registry import get_registry
 from flexflow_tpu.ops.base import Op, OpContext
 
 
@@ -256,6 +257,8 @@ class GraphExecutor:
         if self._jit_train is None:
             self._jit_train = jax.jit(self._train_step_fn(),
                                       donate_argnums=(0, 1, 2))
+            get_registry().inc("executor.train_step_jits")
+            get_registry().gauge("executor.num_ops", len(self.nodes))
         return self._jit_train
 
     def make_multi_step(self, num_iters: int, stacked: bool = False):
@@ -307,6 +310,7 @@ class GraphExecutor:
             return loss, logits, self.metrics.compute(logits, labels)
 
         self._jit_eval = jax.jit(eval_step)
+        get_registry().inc("executor.eval_step_jits")
         return self._jit_eval
 
     def make_forward(self, training: bool = False):

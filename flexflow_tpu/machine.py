@@ -287,6 +287,36 @@ class MachineSpec:
             self.ici_bw * 2
         )
 
+    def collective_time(self, kind: str, bytes_: float,
+                        num_chips: int) -> float:
+        """Analytic time for ``bytes_`` moved by one HLO collective kind
+        (the census vocabulary of flexflow_tpu/obs/inspect.py) over an
+        ``num_chips`` ICI ring. Used by the drift reporter to price the
+        compiled step's REAL collective census through the same machine
+        model the search's simulator uses. Census bytes are
+        per-partition (SPMD module), which matches these formulas'
+        per-chip payload convention."""
+        if num_chips <= 1:
+            return 0.0
+        if kind == "all-reduce":
+            return self.ici_allreduce_time(bytes_, num_chips)
+        if kind == "reduce-scatter":
+            # first half of XLA's large-AR decomposition: half the AR
+            # ring cost of the FULL payload. The census counted the op's
+            # per-shard OUTPUT bytes (1/n of the reduced buffer), so
+            # scale back up before applying the AR formula.
+            return self.ici_allreduce_time(bytes_ * num_chips,
+                                           num_chips) / 2
+        if kind == "all-gather":
+            return self.ici_allgather_time(bytes_, num_chips)
+        if kind == "all-to-all":
+            return self.ici_alltoall_time(bytes_, num_chips)
+        if kind == "collective-permute":
+            # one neighbor hop, full payload over a bidirectional link
+            return self.ici_latency + bytes_ / (self.ici_bw * 2)
+        # unknown kind: price conservatively as an allreduce
+        return self.ici_allreduce_time(bytes_, num_chips)
+
     def dcn_allreduce_time(self, bytes_: int) -> float:
         if self.num_slices <= 1:
             return 0.0

@@ -765,11 +765,83 @@ class FFModel:
         return {n: self._shard_batch(x, cast=True) for n, x in zip(names, xs)}
 
     # ======================= train / eval loops ============================
+    def _make_tracer(self, trace_dir, run_name: str):
+        """Tracer for one fit/evaluate call: the explicit ``trace_dir``
+        argument wins over ``Config --trace-dir``; both unset returns the
+        shared no-op (flexflow_tpu/obs — zero overhead path)."""
+        from flexflow_tpu.obs import make_tracer, model_context
+        tracer = make_tracer(trace_dir or self.config.trace_dir,
+                             run_name=run_name)
+        if tracer.active:
+            tracer.set_meta(**model_context(self))
+        return tracer
+
+    def _finalize_trace(self, tracer, success: bool = True) -> None:
+        """Export the trace + the compiled-step summary (XLA cost/memory
+        analysis, collective census) + the search-drift calibration
+        report. Observability failures warn instead of killing the
+        training run that produced the data.
+
+        ``success=False`` (the run raised) flushes only the trace and
+        counters: the summary/drift reports need a fresh lower+compile
+        of the step (AOT inspection cannot reuse the executor's cached
+        executable), which is minutes of XLA on TPU and — after an OOM
+        — likely to fail again; the trace alone is the diagnosis."""
+        if not tracer.active:
+            return
+        import os
+        import sys
+        from flexflow_tpu.obs import (drift_report, export_step_summary,
+                                      get_registry, write_artifact)
+        try:
+            tracer.export()
+        except Exception as e:
+            print(f"[obs] trace export failed: {e!r}", file=sys.stderr)
+        stem = os.path.join(tracer.trace_dir, tracer.file_stem)
+        extra = dict(run_name=tracer.run_name, run_seq=tracer.run_seq)
+        if success:
+            summary = None
+            try:
+                summary = export_step_summary(self, tracer)
+            except Exception as e:
+                print(f"[obs] step inspection failed: {e!r}",
+                      file=sys.stderr)
+            try:
+                rep = drift_report(
+                    self, tracer.step_time_s(),
+                    census=(summary or {}).get("collectives"),
+                    phase_summary=tracer.phase_summary())
+                write_artifact(stem + ".drift.json", rep,
+                               host_id=tracer.host_id, kind="drift",
+                               header_extra=extra)
+            except Exception as e:
+                print(f"[obs] drift report failed: {e!r}", file=sys.stderr)
+        else:
+            print(f"[obs] run failed: wrote trace/counters only "
+                  f"({tracer.file_stem})", file=sys.stderr)
+        try:
+            get_registry().export(stem + ".counters.json",
+                                  host_id=tracer.host_id)
+        except Exception as e:
+            print(f"[obs] counter export failed: {e!r}", file=sys.stderr)
+
     def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
-                    verbose: bool, on_epoch_start=None) -> float:
+                    verbose: bool, on_epoch_start=None, tracer=None) -> float:
         """Shared epoch loop: per-batch jitted step, on-device metric
         accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
-        report. ``next_batch(epoch, b)`` -> (inputs dict, labels)."""
+        report. ``next_batch(epoch, b)`` -> (inputs dict, labels).
+
+        With an active tracer each step is a span with dispatch /
+        device_wait phases (device_wait fences the step on the loss — an
+        observer effect tracing accepts so per-step times mean device
+        time, not async dispatch time) plus whatever phases the
+        ``next_batch`` closure records (fit: sibling data_load /
+        device_put spans — disjoint, so phase totals sum to step time
+        instead of double-booking H2D under data_load), and each epoch
+        ends with a metrics_sync span (the one host fetch of the
+        accumulated metrics)."""
+        from flexflow_tpu.obs import NULL_TRACER
+        tracer = tracer or NULL_TRACER
         train_step = self.executor.make_train_step()
         self._refresh_compute_params()
         start = time.time()
@@ -780,15 +852,24 @@ class FFModel:
             self._metrics_acc = PerfMetrics()
             mtotals = None
             for b in range(num_batches):
-                inputs, labels = next_batch(epoch, b)
-                self._rng, sub = jax.random.split(self._rng)
-                (self.params, self.opt_state, self.state, loss, mvals) = train_step(
-                    self.params, self.opt_state, self.state, inputs, labels, sub)
-                self._iter += 1
-                mtotals = mvals if mtotals is None else jax.tree.map(
-                    jnp.add, mtotals, mvals)
-            self._metrics_acc.update(dict(mtotals or {}), bs * num_batches)
-            self._last_loss = float(loss)
+                with tracer.step():
+                    inputs, labels = next_batch(epoch, b)
+                    self._rng, sub = jax.random.split(self._rng)
+                    with tracer.phase("dispatch"):
+                        (self.params, self.opt_state, self.state, loss,
+                         mvals) = train_step(
+                            self.params, self.opt_state, self.state,
+                            inputs, labels, sub)
+                    self._iter += 1
+                    mtotals = mvals if mtotals is None else jax.tree.map(
+                        jnp.add, mtotals, mvals)
+                    if tracer.active:
+                        with tracer.phase("device_wait"):
+                            jax.block_until_ready(loss)
+            with tracer.phase("metrics_sync", epoch=epoch):
+                self._metrics_acc.update(dict(mtotals or {}),
+                                         bs * num_batches)
+                self._last_loss = float(loss)
             if verbose:
                 rep = self._metrics_acc.report()
                 print(f"epoch {epoch}: loss={self._last_loss:.4f} " +
@@ -800,9 +881,16 @@ class FFModel:
         return thr
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
-            epochs: Optional[int] = None, verbose: bool = True):
+            epochs: Optional[int] = None, verbose: bool = True,
+            trace_dir: Optional[str] = None):
         """Keras-style whole-dataset training loop, streaming batches from
-        host (base_model.py:376-430 / flexflow_cffi.py:2073-2086)."""
+        host (base_model.py:376-430 / flexflow_cffi.py:2073-2086).
+
+        ``trace_dir`` (or ``Config --trace-dir``) activates the runtime
+        observability subsystem: per-step Chrome-trace/JSONL artifacts,
+        a compiled-step summary (XLA FLOPs/bytes/peak memory +
+        collective census), and a search-drift calibration report land
+        in that directory when the loop finishes."""
         epochs = epochs or self.config.epochs
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
@@ -814,23 +902,50 @@ class FFModel:
         if num_batches == 0:
             raise ValueError(
                 f"dataset of {n} samples is smaller than batch size {lbs}")
+        tracer = self._make_tracer(trace_dir, "fit")
 
         def next_batch(epoch, b):
             sl = slice(b * lbs, (b + 1) * lbs)
-            return (self._stage_inputs([xx[sl] for xx in xs]),
-                    self._shard_batch(y[sl]))
+            with tracer.phase("data_load"):
+                xs_np = [xx[sl] for xx in xs]
+                y_np = y[sl]
+            with tracer.phase("device_put"):
+                return (self._stage_inputs(xs_np),
+                        self._shard_batch(y_np))
 
-        return self._run_epochs(next_batch, num_batches, bs, epochs, verbose)
+        # a traced run that dies mid-training (OOM, NaN assert, ^C)
+        # still flushes its trace — that trace is the diagnosis
+        try:
+            out = self._run_epochs(next_batch, num_batches, bs, epochs,
+                                   verbose, tracer=tracer)
+        except BaseException:
+            self._finalize_trace(tracer, success=False)
+            raise
+        self._finalize_trace(tracer)
+        return out
 
     def fit_loader(self, loaders, epochs: Optional[int] = None,
-                   verbose: bool = True):
+                   verbose: bool = True, trace_dir: Optional[str] = None):
         """Steady-state training from staged on-device loaders
         (flexflow_tpu.dataloader) — no host→device traffic per step."""
         epochs = epochs or self.config.epochs
         bs = loaders.input_loaders[0].batch_size
-        return self._run_epochs(lambda e, b: loaders.next_batch(),
-                                loaders.num_batches, bs, epochs, verbose,
-                                on_epoch_start=loaders.reset)
+        tracer = self._make_tracer(trace_dir, "fit")
+
+        def next_batch(e, b):
+            with tracer.phase("data_load"):
+                return loaders.next_batch()
+
+        try:
+            out = self._run_epochs(next_batch, loaders.num_batches, bs,
+                                   epochs, verbose,
+                                   on_epoch_start=loaders.reset,
+                                   tracer=tracer)
+        except BaseException:
+            self._finalize_trace(tracer, success=False)
+            raise
+        self._finalize_trace(tracer)
+        return out
 
     # ---- checkpoint / resume (new scope vs reference — SURVEY §5.4) -------
     def save_checkpoint(self, path: str) -> None:
@@ -845,7 +960,8 @@ class FFModel:
         from flexflow_tpu.recompile import recompile_on_condition
         return recompile_on_condition(self, recompile_state)
 
-    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None,
+                 trace_dir: Optional[str] = None):
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
         bs_report = batch_size or self.input_tensors[0].shape[0]
@@ -854,16 +970,32 @@ class FFModel:
             raise ValueError(
                 f"dataset of {n} samples is smaller than batch size {bs}")
         eval_step = self.executor.make_eval_step()
+        tracer = self._make_tracer(trace_dir, "evaluate")
         acc = PerfMetrics()
         loss_sum, batches = 0.0, 0
-        for b in range(n // bs):
-            sl = slice(b * bs, (b + 1) * bs)
-            inputs = self._stage_inputs([xx[sl] for xx in xs])
-            labels = self._shard_batch(y[sl])
-            loss, logits, mvals = eval_step(self.params, self.state, inputs, labels)
-            loss_sum += float(loss)
-            batches += 1
-            acc.update({k: v for k, v in mvals.items()}, bs_report)
+        try:
+            for b in range(n // bs):
+                with tracer.step():
+                    sl = slice(b * bs, (b + 1) * bs)
+                    with tracer.phase("device_put"):
+                        inputs = self._stage_inputs([xx[sl] for xx in xs])
+                        labels = self._shard_batch(y[sl])
+                    with tracer.phase("dispatch"):
+                        loss, logits, mvals = eval_step(
+                            self.params, self.state, inputs, labels)
+                    with tracer.phase("metrics_sync"):
+                        loss_sum += float(loss)
+                        batches += 1
+                        acc.update({k: v for k, v in mvals.items()},
+                                   bs_report)
+        finally:
+            if tracer.active:
+                try:
+                    tracer.export()
+                except Exception as e:
+                    import sys
+                    print(f"[obs] trace export failed: {e!r}",
+                          file=sys.stderr)
         rep = acc.report()
         rep["loss"] = loss_sum / max(batches, 1)
         return rep

@@ -89,11 +89,14 @@ def _build(total_devices: int, leg: str = "dp"):
     return ff
 
 
-def _build_and_train(total_devices: int, leg: str = "dp"):
+def _build_and_train(total_devices: int, leg: str = "dp",
+                     trace_dir: Optional[str] = None):
     """Compile + train the dryrun model for _STEPS steps on this
     process's rows of the fixed global batch. Returns
     (FFModel, local_x, local_y) — the local slice is derived ONCE here
-    and reused by callers (evaluate/predict legs)."""
+    and reused by callers (evaluate/predict legs). ``trace_dir``
+    activates the obs step tracer; each process writes artifacts keyed
+    by its host id (jax.process_index)."""
     import jax
 
     ff = _build(total_devices, leg)
@@ -112,9 +115,10 @@ def _build_and_train(total_devices: int, leg: str = "dp"):
         # feeding mechanisms get parity coverage
         from flexflow_tpu.dataloader import create_data_loaders
         loaders = create_data_loaders(ff, lx, ly)
-        ff.fit_loader(loaders, epochs=_STEPS, verbose=False)
+        ff.fit_loader(loaders, epochs=_STEPS, verbose=False,
+                      trace_dir=trace_dir)
     else:
-        ff.fit(lx, ly, epochs=_STEPS, verbose=False)
+        ff.fit(lx, ly, epochs=_STEPS, verbose=False, trace_dir=trace_dir)
     return ff, lx, ly
 
 
@@ -155,7 +159,10 @@ def worker_main(process_id: int, num_processes: int, port: int,
     assert total == num_processes * devices_per_proc, (
         f"expected {num_processes * devices_per_proc} global devices, "
         f"got {total}")
-    ff, lx, ly = _build_and_train(total)
+    # per-host step tracing (FFS_TRACE_DIR, set by run_dryrun): each
+    # worker's fit writes *_hostNN artifacts the parent merges by host id
+    trace_dir = os.environ.get("FFS_TRACE_DIR") or None
+    ff, lx, ly = _build_and_train(total, trace_dir=trace_dir)
     out = {"loss": np.float64(ff._last_loss)}
     out.update({f"dp/{k}": v for k, v in _params_to_numpy(ff).items()})
     # evaluate + predict on the multi-host path: evaluate consumes local
@@ -202,12 +209,16 @@ def _free_port() -> int:
 
 
 def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
-               timeout: int = 600) -> None:
+               timeout: int = 600,
+               trace_dir: Optional[str] = None) -> None:
     """Spawn the workers, train, and assert parity with a single-process
     run on the same global batch. Raises on any mismatch.
 
     The calling process must have >= num_processes * devices_per_proc
-    JAX devices for the single-process reference leg."""
+    JAX devices for the single-process reference leg. ``trace_dir``
+    turns on per-host step tracing in every worker; after the workers
+    exit their per-host Chrome traces are merged into one
+    ``merged.trace.json`` keyed by host id (pid = host in Perfetto)."""
     import jax
 
     total = num_processes * devices_per_proc
@@ -220,6 +231,10 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
         env = dict(os.environ)
         env["FFS_MP_CHILD"] = "1"
         env.pop("JAX_PLATFORMS", None)
+        if trace_dir:
+            env["FFS_TRACE_DIR"] = trace_dir
+        else:
+            env.pop("FFS_TRACE_DIR", None)
         # the per-process backend is configured inside worker_main via
         # jax config (not env), so a sitecustomize cannot override it
         env.pop("XLA_FLAGS", None)
@@ -246,6 +261,12 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
             raise RuntimeError(
                 f"multihost dryrun: worker exit codes {rcs}")
         worker_results = [dict(np.load(o)) for o in outs]
+
+    if trace_dir:
+        from flexflow_tpu.obs import merge_host_traces
+        merged = merge_host_traces(trace_dir)
+        if merged:
+            print(f"multihost dryrun: merged per-host traces -> {merged}")
 
     # single-process references on the same global batch
     if len(jax.devices()) < total:

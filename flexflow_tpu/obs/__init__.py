@@ -1,0 +1,53 @@
+"""Runtime observability: step tracing, compiled-step inspection, drift.
+
+The feedback channel the search stack lacked: costs flow INTO the native
+search (flexflow_tpu/search/profile.py measured tables, machine.py
+analytic comms), and this package makes what the jitted step actually
+does flow back OUT — per-step phase spans (Chrome-trace/Perfetto JSON +
+a JSONL event stream), XLA cost/memory analysis and a collective census
+of the optimized HLO, and a drift report comparing the search's
+predicted step time against the measured one (consumable by
+scripts/calibrate.py). Cf. "A Learned Performance Model for TPUs" /
+SCALE-Sim (PAPERS.md): a calibrated performance model is only as good
+as its feedback loop.
+
+Everything is inert unless a trace dir is set: ``make_tracer(None)``
+returns the shared ``NULL_TRACER`` whose methods are no-ops, so the
+training hot path pays nothing when observability is off.
+"""
+
+from flexflow_tpu.obs.artifacts import artifact_header, write_artifact
+from flexflow_tpu.obs.drift import drift_report
+from flexflow_tpu.obs.inspect import (
+    collective_census,
+    export_step_summary,
+    inspect_compiled,
+    inspect_model_step,
+    model_context,
+)
+from flexflow_tpu.obs.registry import CounterRegistry, get_registry
+from flexflow_tpu.obs.tracer import (
+    NULL_TRACER,
+    NullTracer,
+    StepTracer,
+    make_tracer,
+    merge_host_traces,
+)
+
+__all__ = [
+    "artifact_header",
+    "write_artifact",
+    "drift_report",
+    "collective_census",
+    "export_step_summary",
+    "inspect_compiled",
+    "inspect_model_step",
+    "model_context",
+    "CounterRegistry",
+    "get_registry",
+    "NULL_TRACER",
+    "NullTracer",
+    "StepTracer",
+    "make_tracer",
+    "merge_host_traces",
+]

@@ -1,0 +1,158 @@
+"""Search-drift calibration: predicted vs measured step time.
+
+Closes the loop the native search never had: its cost model predicts an
+iteration time from per-op costs (measured microbenchmarks when
+``--search-measure-ops`` ran, analytic FLOP/byte roofline otherwise)
+divided by each op's sharding work division, plus machine-model
+collective costs — and nothing ever checked that prediction against
+the step the chip actually ran. ``drift_report`` rebuilds the same
+prediction in Python (profile.py measured table scaled by the
+strategy's work division + machine.py analytic comms priced from the
+REAL collective census) and compares it with the tracer's measured
+step time. The report is consumable by ``scripts/calibrate.py
+--ingest-drift``, which folds the ratios into CALIBRATION.json — the
+same file the memory-aware search already reads its correction factor
+from.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def work_division(node, mesh) -> int:
+    """How many ways the strategy splits this op's work: the product of
+    the mesh-axis extents its primary output is sharded over (the analog
+    of the reference scaling measured op cost by the MachineView degree)."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    spec = node.output_specs[0] if node.output_specs else None
+    if spec is None:
+        return 1
+    div = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        for axis in (entry if isinstance(entry, tuple) else (entry,)):
+            div *= axis_sizes.get(axis, 1)
+    return max(div, 1)
+
+
+def _analytic_op_cost(op, machine_spec) -> float:
+    """Roofline forward-pass estimate when no measured table exists:
+    max(FLOP time at MXU efficiency, HBM time for in+out+params),
+    floored at the per-kernel dispatch overhead."""
+    import numpy as np
+
+    flop_s = op.flops() / (machine_spec.flops
+                           * getattr(machine_spec, "mxu_efficiency", 0.55))
+    bytes_ = 4.0 * (sum(float(np.prod(s)) for s in op.input_shapes)
+                    + sum(float(np.prod(s)) for s in op.output_shapes)
+                    + float(op.params_elems()))
+    mem_s = bytes_ / machine_spec.hbm_bw
+    return max(flop_s, mem_s, getattr(machine_spec, "min_op_time", 5e-7))
+
+
+def predicted_step_time(ff, measured: Optional[Dict[str, float]] = None
+                        ) -> Dict[str, Any]:
+    """Per-op + comms prediction of one training-step wall time.
+
+    ``measured``: profile.py's ``{"<guid>:fwd": s, "<guid>:bwd": s}``
+    table (defaults to ``ff.op_profile`` when ``--profiling`` or
+    ``--search-measure-ops`` populated it). Ops absent from the table
+    fall back to the analytic roofline — per-op rows record which
+    source priced them.
+    """
+    measured = measured if measured is not None else (ff.op_profile or {})
+    mesh = ff.mesh
+    spec = ff.machine_spec
+    per_op: List[Dict[str, Any]] = []
+    compute_s = 0.0
+    for node in ff.executor.nodes:
+        op = node.op
+        fwd = measured.get(f"{op.guid}:fwd")
+        bwd = measured.get(f"{op.guid}:bwd")
+        source = "measured"
+        if fwd is None:
+            fwd = _analytic_op_cost(op, spec)
+            bwd = 2.0 * fwd
+            source = "analytic"
+        elif bwd is None:
+            bwd = 2.0 * fwd
+        div = work_division(node, mesh)
+        op_s = (fwd + bwd) / div
+        compute_s += op_s
+        per_op.append(dict(name=op.name, guid=op.guid,
+                           type=op.op_type.name, fwd_s=fwd, bwd_s=bwd,
+                           work_div=div, sharded_s=op_s, source=source))
+    overhead_s = float(measured.get("__step_overhead__", 0.0))
+    return dict(compute_s=compute_s, step_overhead_s=overhead_s,
+                per_op=per_op,
+                measured_ops=sum(1 for r in per_op
+                                 if r["source"] == "measured"))
+
+
+def predicted_comm_time(ff, census: Dict[str, Dict[str, float]]
+                        ) -> Dict[str, Any]:
+    """Price the REAL collective census (per-partition bytes from the
+    compiled HLO) through the machine model's analytic collective costs
+    — the comms half of the prediction, fed by actual emissions instead
+    of the simulator's guess at which collectives GSPMD inserts.
+
+    The census is unfiltered (includes the scalar loss/metric
+    reductions the validator's ``PRICED_MIN_BYTES`` drops): pricing is
+    per-kind on aggregate bytes — latency paid once per kind, not per
+    op — so the scalars perturb predicted_s at noise level while the
+    report stays a complete account of what the step moves."""
+    n_chips = int(ff.mesh.devices.size)
+    spec = ff.machine_spec
+    per_kind: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    for kind, entry in (census or {}).items():
+        t = spec.collective_time(kind, entry["bytes"], n_chips)
+        per_kind[kind] = dict(entry, predicted_s=t)
+        total += t
+    return dict(comm_s=total, per_kind=per_kind)
+
+
+def drift_report(ff, measured_step_s: Optional[float],
+                 census: Optional[Dict[str, Dict[str, float]]] = None,
+                 measured: Optional[Dict[str, float]] = None,
+                 phase_summary: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """The calibration report: predicted-vs-measured step-time ratio.
+
+    ``measured_step_s``: steady-state step wall time (tracer median).
+    ``census``: collective census from the compiled step (inspector);
+    None prices zero comms. Also carries the native search's own
+    prediction (``search_info["predicted_time"]``) when one exists, so
+    drift of the REAL search — not just this reconstruction — is
+    visible.
+    """
+    pred = predicted_step_time(ff, measured=measured)
+    comm = predicted_comm_time(ff, census or {})
+    total = pred["compute_s"] + pred["step_overhead_s"] + comm["comm_s"]
+    ratio = (total / measured_step_s
+             if measured_step_s and measured_step_s > 0 else None)
+    search_pred = None
+    if isinstance(ff.search_info, dict):
+        search_pred = ff.search_info.get("predicted_time")
+    search_ratio = (search_pred / measured_step_s
+                    if search_pred and measured_step_s else None)
+    report = dict(
+        predicted=dict(total_s=total,
+                       compute_s=pred["compute_s"],
+                       comm_s=comm["comm_s"],
+                       step_overhead_s=pred["step_overhead_s"],
+                       measured_ops=pred["measured_ops"],
+                       num_ops=len(pred["per_op"])),
+        measured=dict(step_s=measured_step_s),
+        ratio=ratio,
+        search_predicted_s=search_pred,
+        search_ratio=search_ratio,
+        per_op=pred["per_op"],
+        comm=comm["per_kind"],
+        mesh_axes=dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape)),
+    )
+    if phase_summary:
+        report["phases"] = phase_summary
+    return report

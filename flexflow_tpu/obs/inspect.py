@@ -1,0 +1,189 @@
+"""Compiled-step inspector: XLA cost/memory analysis + collective census.
+
+The single owner of HLO-text parsing for collectives (the priced-vs-
+emitted validator in ``flexflow_tpu/search/validate.py`` builds its
+byte totals on this census). ``inspect_model_step`` lowers + compiles
+the model's jitted train step on the live mesh and reports what the
+program ACTUALLY is: FLOPs and HBM bytes accessed (XLA cost analysis),
+per-device argument/temp/peak bytes (XLA memory analysis), and the
+per-step collective census — all-reduce / all-gather / reduce-scatter /
+all-to-all / collective-permute counts and payload byte volumes.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from typing import Any, Dict, Optional
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s32": 4, "u32": 4,
+    "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+# HLO collective opcodes the census recognizes (async -start/-done pairs
+# count once via the -start op)
+COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+                    "all-to-all", "collective-permute")
+
+# The payload threshold below which the search's simulator does not
+# price a collective (scalar loss/metric reductions). The validator
+# (search/validate.py) filters its census with this; the observability
+# summary deliberately does NOT — it reports every collective the step
+# runs — and records the threshold it used as ``collectives_min_bytes``.
+PRICED_MIN_BYTES = float(1 << 12)
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(" + "|".join(COLLECTIVE_KINDS) + r")(-start|-done)?(\.\d+)?\(")
+
+
+def shape_bytes(shape_str: str) -> float:
+    """Total bytes of an HLO shape string like ``f32[128,256]`` or a
+    variadic tuple ``(f32[8,4], f32[8,4])``."""
+    total = 0.0
+    for m in _SHAPE_RE.finditer(shape_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def collective_census(hlo_text: str, min_bytes: float = 0.0
+                      ) -> Dict[str, Dict[str, float]]:
+    """HLO opcode -> {count, bytes} over the optimized (SPMD) module.
+
+    Byte volume is each op's OUTPUT shape — per-partition bytes in an
+    SPMD module, i.e. what one device moves per step. The default
+    ``min_bytes=0`` keeps every collective, scalar loss/metric
+    reductions included; pass ``PRICED_MIN_BYTES`` to drop the ones the
+    search's simulator deliberately does not price (as the validator
+    does). HLO lines read ``%name = SHAPE opcode(operands)``; splitting
+    at the first `` = `` keeps LHS names like ``%all-reduce.58`` from
+    matching.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        if " = " not in line:
+            continue
+        rhs = line.split(" = ", 1)[1]
+        m = _COLLECTIVE_RE.search(rhs)
+        if not m or m.group(2) == "-done":
+            continue
+        b = shape_bytes(rhs[:m.start()])
+        if b < min_bytes:
+            continue
+        kind = m.group(1)
+        e = out.setdefault(kind, dict(count=0, bytes=0.0))
+        e["count"] += 1
+        e["bytes"] += b
+    return out
+
+
+def census_totals(census: Dict[str, Dict[str, float]]) -> Dict[str, float]:
+    return dict(
+        count=sum(e["count"] for e in census.values()),
+        bytes=sum(e["bytes"] for e in census.values()),
+    )
+
+
+def inspect_compiled(compiled) -> Dict[str, Any]:
+    """Cost + memory analysis + collective census of one jax ``Compiled``.
+
+    Robust to backend gaps: any analysis a backend does not implement
+    reports as None rather than raising (the CPU backend implements all
+    three as of jax 0.4.x).
+    """
+    out: Dict[str, Any] = dict(flops=None, bytes_accessed=None,
+                               transcendentals=None)
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out["flops"] = float(ca.get("flops", 0.0)) or None
+            out["bytes_accessed"] = (
+                float(ca.get("bytes accessed", 0.0)) or None)
+            t = ca.get("transcendentals")
+            out["transcendentals"] = float(t) if t else None
+    except Exception:
+        pass
+    mem: Optional[Dict[str, float]] = None
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = float(getattr(ma, "argument_size_in_bytes", 0))
+            tmp = float(getattr(ma, "temp_size_in_bytes", 0))
+            mem = dict(
+                argument_bytes=arg,
+                output_bytes=float(getattr(ma, "output_size_in_bytes", 0)),
+                temp_bytes=tmp,
+                generated_code_bytes=float(
+                    getattr(ma, "generated_code_size_in_bytes", 0)),
+                # the per-device peak an HBM budget must cover: live
+                # arguments (params/opt state/batch) + XLA temp — same
+                # definition as search/validate.compiled_footprint_bytes
+                peak_bytes=arg + tmp,
+            )
+    except Exception:
+        pass
+    out["memory"] = mem
+    census: Dict[str, Dict[str, float]] = {}
+    try:
+        census = collective_census(compiled.as_text())
+    except Exception:
+        pass
+    out["collectives"] = census
+    out["collectives_total"] = census_totals(census)
+    out["collectives_min_bytes"] = 0.0
+    return out
+
+
+def export_step_summary(ff, tracer) -> Dict[str, Any]:
+    """Inspect the compiled train step and write the ``.summary.json``
+    artifact next to the tracer's other files (the one emission path
+    shared by ``FFModel._finalize_trace`` and ``bench.py``). Returns
+    the summary dict."""
+    import os
+
+    from flexflow_tpu.obs.artifacts import write_artifact
+
+    summary = inspect_model_step(ff)
+    path = os.path.join(tracer.trace_dir, tracer.file_stem + ".summary.json")
+    write_artifact(path, summary, host_id=tracer.host_id,
+                   kind="step_summary",
+                   header_extra=dict(run_name=tracer.run_name,
+                                     run_seq=tracer.run_seq))
+    return summary
+
+
+def model_context(ff) -> Dict[str, Any]:
+    """Graph/mesh context the raw XLA numbers need to be interpreted —
+    the ONE definition shared by the trace header (FFModel._make_tracer)
+    and the step summary, so the two artifacts can never desync."""
+    return dict(
+        num_ops=len(ff.executor.nodes),
+        mesh_axes=dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape)),
+        batch_size=(ff.input_tensors[0].shape[0]
+                    if ff.input_tensors else None),
+    )
+
+
+def inspect_model_step(ff) -> Dict[str, Any]:
+    """Inspect the compiled TRAIN step of a compiled FFModel: lowers the
+    jitted step on the live mesh with representative inputs and runs
+    ``inspect_compiled`` on it (a fresh lower+compile — AOT inspection
+    cannot reuse the executor's cached executable)."""
+    from flexflow_tpu.search.validate import compiled_train_step
+
+    compiled = compiled_train_step(ff)
+    out = inspect_compiled(compiled)
+    out.update(model_context(ff))
+    return out

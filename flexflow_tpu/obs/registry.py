@@ -1,0 +1,79 @@
+"""Counter/gauge registry: cheap process-wide runtime counters.
+
+The executor and tracer increment counters here (jit compiles, traced
+steps, graph sizes); ``export()`` snapshots the registry as a
+version-stamped JSON artifact. Deliberately tiny — dict bumps on paths
+that already pay a jit dispatch, nothing that could show up in a
+benchmark profile.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class CounterRegistry:
+    """Monotonic counters + last-value gauges + min/max/sum observations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._observations: Dict[str, Dict[str, float]] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Streaming count/sum/min/max summary (no per-sample storage)."""
+        v = float(value)
+        with self._lock:
+            o = self._observations.get(name)
+            if o is None:
+                self._observations[name] = dict(count=1.0, sum=v, min=v,
+                                                max=v)
+            else:
+                o["count"] += 1.0
+                o["sum"] += v
+                o["min"] = min(o["min"], v)
+                o["max"] = max(o["max"], v)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(
+                counters=dict(self._counters),
+                gauges=dict(self._gauges),
+                observations={k: dict(v)
+                              for k, v in self._observations.items()},
+            )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._observations.clear()
+
+    def export(self, path: str, host_id: Optional[int] = None) -> str:
+        from flexflow_tpu.obs.artifacts import write_artifact
+        return write_artifact(path, self.to_dict(), host_id=host_id,
+                              kind="counters")
+
+
+_REGISTRY = CounterRegistry()
+
+
+def get_registry() -> CounterRegistry:
+    """The process-wide default registry."""
+    return _REGISTRY

@@ -16,17 +16,13 @@ Priced side: replay the searched assignment through the native simulator
 
 from __future__ import annotations
 
-import re
 from collections import defaultdict
 from typing import Any, Dict, List, Tuple
 
 import jax
 import numpy as np
 
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s32": 4, "u32": 4,
-    "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8,
-}
+from flexflow_tpu.obs.inspect import PRICED_MIN_BYTES, collective_census
 
 # kind normalization: HLO op -> the simulator's collective vocabulary
 _HLO_KINDS = {
@@ -37,53 +33,21 @@ _HLO_KINDS = {
     "all-to-all": "reshard",
 }
 
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
-
-def _shape_bytes(shape_str: str) -> float:
-    """Total bytes of an HLO shape string like 'f32[128,256]' or a tuple
-    '(f32[8,4], f32[8,4])'."""
-    total = 0.0
-    for m in _SHAPE_RE.finditer(shape_str):
-        dt, dims = m.group(1), m.group(2)
-        if dt not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-def emitted_collectives(hlo_text: str, min_bytes: float = 1 << 12
+def emitted_collectives(hlo_text: str, min_bytes: float = PRICED_MIN_BYTES
                         ) -> Dict[str, float]:
     """Collective kind -> summed payload bytes in the optimized HLO.
 
-    Byte counting uses each op's OUTPUT shape (per-partition in the SPMD
-    module). Ops below ``min_bytes`` are ignored (loss/metric scalar
-    reductions the simulator deliberately does not price). ``start``
-    variants (async pairs) are counted once via the -start op.
+    A normalization of the obs collective census onto the simulator's
+    vocabulary. Byte counting uses each op's OUTPUT shape
+    (per-partition in the SPMD module). Ops below ``min_bytes`` are
+    ignored (loss/metric scalar reductions the simulator deliberately
+    does not price); async -start/-done pairs count once.
     """
     out: Dict[str, float] = defaultdict(float)
-    op_re = re.compile(r"\b(all-reduce|reduce-scatter|all-gather|"
-                       r"collective-permute|all-to-all)"
-                       r"(-start|-done)?(\.\d+)?\(")
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        # HLO: "%name = SHAPE opcode(operands...)". Split at the first
-        # " = " so the LHS name (e.g. %all-reduce.58) can't match; shapes
-        # may be variadic tuples with /*index=N*/ comments.
-        if " = " not in line:
-            continue
-        rhs = line.split(" = ", 1)[1]
-        m = op_re.search(rhs)
-        if not m or m.group(2) == "-done":
-            continue
-        b = _shape_bytes(rhs[:m.start()])
-        if b < min_bytes:
-            continue
-        out[_HLO_KINDS[m.group(1)]] += b
+    for kind, entry in collective_census(hlo_text,
+                                         min_bytes=min_bytes).items():
+        out[_HLO_KINDS.get(kind, kind)] += entry["bytes"]
     return dict(out)
 
 

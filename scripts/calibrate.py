@@ -12,6 +12,14 @@ iteration on a 1-chip mesh; (3) time the actual jitted train step; report
 predicted/actual. Results land in CALIBRATION.json.
 
 Usage: python scripts/calibrate.py [--quick]
+       python scripts/calibrate.py --ingest-drift TRACE_DIR
+
+``--ingest-drift`` consumes the runtime drift reports the obs subsystem
+writes next to its traces (``Model.fit(..., trace_dir=...)`` →
+``*.drift.json``: predicted-vs-measured step time from REAL training
+steps rather than this script's synthetic timing loop) and folds them
+into CALIBRATION.json's results, so search recalibration sees drift
+observed in production runs too.
 """
 
 from __future__ import annotations
@@ -165,9 +173,85 @@ def actual_step_time(ff, xs, y, repeats=3):
     return max(ts[len(ts) // 2], 1e-9)
 
 
+def ingest_drift(trace_dir: str) -> int:
+    """Fold ``*.drift.json`` obs artifacts into CALIBRATION.json.
+
+    Each drift report becomes a results row (model = the trace's run
+    name, predicted/actual step seconds, ratio) tagged
+    ``source: "drift_report"`` so rows from the synthetic timing loop
+    and rows observed from real training runs stay distinguishable.
+    Rows are keyed by (trace_dir, artifact): re-ingesting a directory
+    replaces its previous rows in place, while reports from a different
+    directory — e.g. another model whose fit also traced as "fit" —
+    accumulate alongside instead of being clobbered.
+    """
+    import glob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cal_path = os.path.join(repo, "CALIBRATION.json")
+    try:
+        with open(cal_path) as f:
+            cal = json.load(f)
+    except (OSError, ValueError):
+        cal = dict(results=[])
+    cal.setdefault("results", [])
+    paths = sorted(glob.glob(os.path.join(trace_dir, "*.drift.json")))
+    if not paths:
+        print(f"no *.drift.json artifacts in {trace_dir}")
+        return 1
+    rows = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"skip {p}: {e}")
+            continue
+        header = rep.get("header", {})
+        pred = (rep.get("predicted") or {}).get("total_s")
+        act = (rep.get("measured") or {}).get("step_s")
+        ratio = rep.get("ratio")
+        if not (pred and act):
+            print(f"skip {os.path.basename(p)}: no predicted/measured pair")
+            continue
+        rows.append(dict(
+            model=str(header.get("run_name", "unknown")),
+            predicted_s=float(pred),
+            actual_s=float(act),
+            ratio=round(float(ratio), 4) if ratio else None,
+            within_tolerance=bool(ratio is not None
+                                  and abs(ratio - 1.0) <= TOLERANCE),
+            source="drift_report",
+            version=header.get("flexflow_tpu_version"),
+            platform=header.get("platform"),
+            trace_dir=os.path.abspath(trace_dir),
+            artifact=os.path.basename(p),
+        ))
+        print(f"{rows[-1]['model']:12s} predicted {pred * 1e3:8.3f} ms   "
+              f"actual {act * 1e3:8.3f} ms   ratio {rows[-1]['ratio']}")
+    if not rows:
+        return 1
+    ingested = {(r["trace_dir"], r["artifact"]) for r in rows}
+    cal["results"] = [r for r in cal["results"]
+                      if not (r.get("source") == "drift_report"
+                              and (r.get("trace_dir"),
+                                   r.get("artifact")) in ingested)] + rows
+    with open(cal_path, "w") as f:
+        json.dump(cal, f, indent=1)
+    print(f"ingested {len(rows)} drift report(s) into {cal_path}")
+    return 0
+
+
 def main():
     import jax
 
+    if "--ingest-drift" in sys.argv:
+        i = sys.argv.index("--ingest-drift")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
+            print("usage: calibrate.py --ingest-drift TRACE_DIR",
+                  file=sys.stderr)
+            return 2
+        return ingest_drift(sys.argv[i + 1])
     quick = "--quick" in sys.argv or jax.devices()[0].platform == "cpu"
     from flexflow_tpu.search.profile import microbenchmark
 

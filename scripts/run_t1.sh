@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Tier-1 verification gate — the EXACT command from ROADMAP.md
+# ("Tier-1 verify"), wrapped so the builder, CI, and any reviewer run
+# the identical gate. Keep this in lockstep with ROADMAP.md: if the
+# roadmap command changes, change it here in the same commit.
+#
+# Usage: scripts/run_t1.sh      (run from anywhere; cd's to the repo root)
+cd "$(dirname "$0")/.." || exit 2
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

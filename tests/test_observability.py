@@ -1,0 +1,447 @@
+"""Observability subsystem tests (flexflow_tpu/obs).
+
+Acceptance (ISSUE 1): a traced ``fit`` on a small MLP produces a
+Chrome-trace JSON with per-step spans, a summary JSON with HLO
+FLOPs/bytes/peak-memory + a collective census, and a drift report with
+a predicted-vs-measured step-time ratio — all on the CPU backend.
+Plus: PerfMetrics accumulation semantics, the no-op tracer fast path,
+census parsing, the counter registry, and bench.py's ratchet/atomic
+history handling.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+    __version__,
+)
+from flexflow_tpu.ffconst import ActiMode
+
+
+def make_blobs(n=128, d=8, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d) * 3
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def build_mlp(batch_size=32, **cfg_kwargs):
+    ff = FFModel(FFConfig(batch_size=batch_size, **cfg_kwargs))
+    t = ff.create_tensor((batch_size, 8))
+    t = ff.dense(t, 16, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.ACCURACY])
+    return ff
+
+
+class TestTracedFit:
+    """The acceptance path: fit(trace_dir=...) emits all artifacts."""
+
+    @pytest.fixture(scope="class")
+    def traced_run(self, tmp_path_factory):
+        td = str(tmp_path_factory.mktemp("trace"))
+        x, y = make_blobs()
+        ff = build_mlp()
+        ff.fit(x, y, epochs=2, verbose=False, trace_dir=td)
+        return td, ff
+
+    def _one(self, td, pattern):
+        paths = glob.glob(os.path.join(td, pattern))
+        assert len(paths) == 1, f"{pattern}: {paths}"
+        return paths[0]
+
+    def test_chrome_trace_with_step_spans(self, traced_run):
+        td, _ = traced_run
+        trace = json.load(open(self._one(td, "fit_*.trace.json")))
+        events = trace["traceEvents"]
+        steps = [e for e in events if e.get("name") == "step"
+                 and e.get("ph") == "X"]
+        # 128 samples / 32 batch * 2 epochs = 8 steps
+        assert len(steps) == 8
+        assert all(e["dur"] > 0 for e in steps)
+        # the issue's phase vocabulary is all present, nested in steps
+        names = {e["name"] for e in events}
+        for phase in ("data_load", "device_put", "dispatch",
+                      "device_wait", "metrics_sync"):
+            assert phase in names, f"missing phase {phase}"
+        # version stamped into the artifact header (satellite)
+        assert trace["metadata"]["flexflow_tpu_version"] == __version__
+        assert trace["metadata"]["host_id"] == 0
+
+    def test_jsonl_stream(self, traced_run):
+        td, _ = traced_run
+        lines = [json.loads(ln) for ln in
+                 open(self._one(td, "fit_*.events.jsonl"))]
+        assert lines[0]["record"] == "header"
+        assert lines[0]["flexflow_tpu_version"] == __version__
+        assert sum(1 for e in lines[1:] if e["name"] == "step") == 8
+
+    def test_summary_hlo_costs_and_census(self, traced_run):
+        td, _ = traced_run
+        summ = json.load(open(self._one(td, "fit_*.summary.json")))
+        assert summ["header"]["flexflow_tpu_version"] == __version__
+        assert summ["flops"] > 0
+        assert summ["bytes_accessed"] > 0
+        assert summ["memory"]["peak_bytes"] > 0
+        assert summ["memory"]["argument_bytes"] > 0
+        # data-parallel grad sync over the 8-device CPU mesh MUST show
+        # up as all-reduces in the census
+        census = summ["collectives"]
+        assert "all-reduce" in census
+        assert census["all-reduce"]["count"] >= 1
+        assert census["all-reduce"]["bytes"] > 0
+        assert summ["collectives_total"]["count"] >= 1
+        assert summ["mesh_axes"] == {"data": 8}
+
+    def test_drift_report(self, traced_run):
+        td, _ = traced_run
+        rep = json.load(open(self._one(td, "fit_*.drift.json")))
+        assert rep["header"]["flexflow_tpu_version"] == __version__
+        assert rep["predicted"]["total_s"] > 0
+        assert rep["measured"]["step_s"] > 0
+        assert rep["ratio"] > 0
+        # every op priced, with its sharding work division recorded
+        assert rep["predicted"]["num_ops"] == 3
+        assert all(r["work_div"] >= 1 for r in rep["per_op"])
+        assert any(r["work_div"] == 8 for r in rep["per_op"])  # dp=8
+        # comms priced from the census through the machine model
+        assert "all-reduce" in rep["comm"]
+        assert rep["comm"]["all-reduce"]["predicted_s"] > 0
+        # phase attribution rode along
+        assert "dispatch" in rep["phases"]
+
+    def test_counters_exported(self, traced_run):
+        td, _ = traced_run
+        counters = json.load(open(self._one(td, "fit_*.counters.json")))
+        assert counters["counters"]["executor.train_step_jits"] >= 1
+
+    def test_drift_ingestable_by_calibrate(self, traced_run, tmp_path,
+                                           monkeypatch):
+        """The drift report round-trips through scripts/calibrate.py
+        --ingest-drift into CALIBRATION.json rows."""
+        import importlib.util
+        import sys
+        td, _ = traced_run
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "calibrate", os.path.join(repo, "scripts", "calibrate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # redirect CALIBRATION.json writes into tmp_path
+        fake_repo = tmp_path / "repo"
+        (fake_repo / "scripts").mkdir(parents=True)
+        monkeypatch.setattr(mod.os.path, "abspath",
+                            lambda p: str(fake_repo / "scripts" / "x.py"))
+        assert mod.ingest_drift(td) == 0
+        cal = json.load(open(fake_repo / "CALIBRATION.json"))
+        rows = [r for r in cal["results"]
+                if r.get("source") == "drift_report"]
+        assert len(rows) == 1
+        assert rows[0]["model"] == "fit"
+        assert rows[0]["predicted_s"] > 0
+        assert rows[0]["actual_s"] > 0
+
+
+class TestTracerOffIsNoop:
+    def test_fit_without_trace_dir_writes_nothing(self, tmp_path):
+        x, y = make_blobs(64)
+        ff = build_mlp()
+        cwd_before = set(os.listdir(os.getcwd()))
+        ff.fit(x, y, epochs=1, verbose=False)
+        assert set(os.listdir(os.getcwd())) == cwd_before
+        assert os.listdir(str(tmp_path)) == []
+
+    def test_null_tracer_shared_and_inert(self):
+        from flexflow_tpu.obs import NULL_TRACER, make_tracer
+        t = make_tracer(None)
+        assert t is NULL_TRACER
+        assert not t.active
+        with t.step():
+            with t.phase("anything", foo=1):
+                pass
+        t.instant("x")
+        assert t.export() == {}
+        assert t.step_time_s() is None
+
+    def test_crashed_fit_still_flushes_trace(self, tmp_path):
+        # a traced run that dies mid-training must still export its
+        # buffered spans — that trace is the diagnosis of the crash
+        td = str(tmp_path)
+        x, y = make_blobs(128)
+        ff = build_mlp()
+        real = ff.executor.make_train_step()
+        calls = {"n": 0}
+
+        def dying_step(*args):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise RuntimeError("injected mid-training failure")
+            return real(*args)
+
+        ff.executor.make_train_step = lambda: dying_step
+        with pytest.raises(RuntimeError, match="injected"):
+            ff.fit(x, y, epochs=2, verbose=False, trace_dir=td)
+        trace = json.load(open(glob.glob(
+            os.path.join(td, "fit_*.trace.json"))[0]))
+        steps = [e for e in trace["traceEvents"]
+                 if e.get("name") == "step" and e.get("ph") == "X"]
+        # 2 completed steps + the aborted one (its span closes on the
+        # way out, so the trace shows exactly where the run died)
+        assert len(steps) == 3
+        # the failure path flushes trace/counters ONLY: summary + drift
+        # need a fresh lower+compile, which a dead run must not pay
+        assert glob.glob(os.path.join(td, "fit_*.summary.json")) == []
+        assert glob.glob(os.path.join(td, "fit_*.drift.json")) == []
+        assert len(glob.glob(os.path.join(td, "fit_*.counters.json"))) == 1
+
+    def test_unusable_trace_dir_degrades_to_noop(self, tmp_path):
+        from flexflow_tpu.obs import NULL_TRACER, make_tracer
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("")
+        t = make_tracer(str(blocker / "sub"))
+        assert t is NULL_TRACER
+        # and a traced fit pointed there still trains
+        x, y = make_blobs(64)
+        ff = build_mlp()
+        ff.fit(x, y, epochs=1, verbose=False,
+               trace_dir=str(blocker / "sub"))
+
+    def test_evaluate_traced(self, tmp_path):
+        td = str(tmp_path)
+        x, y = make_blobs(64)
+        ff = build_mlp()
+        ff.evaluate(x, y, trace_dir=td)
+        paths = glob.glob(os.path.join(td, "evaluate_*.trace.json"))
+        assert len(paths) == 1
+        trace = json.load(open(paths[0]))
+        assert any(e.get("name") == "step"
+                   for e in trace["traceEvents"])
+
+
+class TestPerfMetricsAccumulation:
+    """Satellite: accumulation semantics — reset BETWEEN epochs,
+    accumulate WITHIN an epoch."""
+
+    def test_update_accumulates_within_epoch(self):
+        from flexflow_tpu.metrics import PerfMetrics
+        pm = PerfMetrics()
+        pm.update({"accuracy": np.int32(10), "mse_loss": 2.0}, 32)
+        pm.update({"accuracy": np.int32(6), "mse_loss": 1.0}, 32)
+        assert pm.train_all == 64
+        assert pm.train_correct == 16
+        rep = pm.report()
+        assert rep["accuracy"] == pytest.approx(16 / 64)
+        assert rep["mse_loss"] == pytest.approx(3.0 / 64)
+
+    def test_fit_resets_between_epochs(self):
+        """After N epochs the accumulator holds ONE epoch's samples (a
+        fresh PerfMetrics per epoch), not the whole run's."""
+        x, y = make_blobs(128)
+        ff = build_mlp()
+        ff.fit(x, y, epochs=3, verbose=False)
+        assert ff._metrics_acc.train_all == 128  # not 3 * 128
+        # and within the final epoch all 4 batches accumulated
+        assert 0 < ff._metrics_acc.train_correct <= 128
+
+    def test_evaluate_accumulates_all_batches(self):
+        x, y = make_blobs(96)
+        ff = build_mlp()
+        rep = ff.evaluate(x, y)
+        assert "accuracy" in rep and "loss" in rep
+        assert 0.0 <= rep["accuracy"] <= 1.0
+
+
+class TestCollectiveCensus:
+    def test_parses_counts_and_bytes(self):
+        from flexflow_tpu.obs.inspect import collective_census
+        hlo = """
+  %x = f32[128,256] parameter(0)
+  %all-reduce.1 = f32[128,256]{1,0} all-reduce(f32[128,256] %x), replica_groups={}
+  %ag = f32[8,64] all-gather(f32[2,64] %y), dimensions={0}
+  %rs-start = f32[64] reduce-scatter-start(f32[256] %z)
+  %all-reduce-start.2 = f32[16]{0} all-reduce-start(f32[16] %w)
+  %all-reduce-done.2 = f32[16]{0} all-reduce-done(%all-reduce-start.2)
+"""
+        census = collective_census(hlo)
+        assert census["all-reduce"]["count"] == 2
+        assert census["all-reduce"]["bytes"] == 128 * 256 * 4 + 16 * 4
+        assert census["all-gather"]["count"] == 1
+        assert census["all-gather"]["bytes"] == 8 * 64 * 4
+        assert census["reduce-scatter"]["count"] == 1
+
+    def test_lhs_names_do_not_match(self):
+        from flexflow_tpu.obs.inspect import collective_census
+        hlo = "%all-reduce.5 = f32[4] add(f32[4] %a, f32[4] %b)"
+        assert collective_census(hlo) == {}
+
+    def test_min_bytes_filter(self):
+        from flexflow_tpu.obs.inspect import collective_census
+        hlo = "%r = f32[2] all-reduce(f32[2] %a)"
+        assert collective_census(hlo, min_bytes=1 << 12) == {}
+        assert collective_census(hlo)["all-reduce"]["bytes"] == 8
+
+    def test_validator_uses_census(self):
+        """search/validate.emitted_collectives is the census normalized
+        onto the simulator vocabulary (refactor must stay consistent)."""
+        from flexflow_tpu.search.validate import emitted_collectives
+        hlo = """
+  %ar = f32[4096] all-reduce(f32[4096] %a)
+  %rs = f32[2048] reduce-scatter(f32[4096] %b)
+  %cp = f32[4096] collective-permute(f32[4096] %c)
+"""
+        out = emitted_collectives(hlo, min_bytes=1024)
+        assert out["allreduce"] == 4096 * 4 + 2048 * 4
+        assert out["ppermute"] == 4096 * 4
+
+
+class TestCounterRegistry:
+    def test_counters_gauges_observations(self):
+        from flexflow_tpu.obs.registry import CounterRegistry
+        r = CounterRegistry()
+        r.inc("a")
+        r.inc("a", 2)
+        r.gauge("g", 7.5)
+        r.observe("o", 1.0)
+        r.observe("o", 3.0)
+        d = r.to_dict()
+        assert d["counters"]["a"] == 3
+        assert d["gauges"]["g"] == 7.5
+        assert d["observations"]["o"] == dict(count=2.0, sum=4.0,
+                                              min=1.0, max=3.0)
+        assert r.get("a") == 3
+        r.reset()
+        assert r.to_dict()["counters"] == {}
+
+    def test_export_stamps_header(self, tmp_path):
+        from flexflow_tpu.obs.registry import CounterRegistry
+        r = CounterRegistry()
+        r.inc("x")
+        path = r.export(str(tmp_path / "c.json"))
+        data = json.load(open(path))
+        assert data["header"]["flexflow_tpu_version"] == __version__
+        assert data["counters"]["x"] == 1
+
+
+class TestMachineCollectiveTime:
+    def test_kinds_priced(self):
+        from flexflow_tpu.machine import MachineSpec
+        spec = MachineSpec(chip="tpu-v5e", chips_per_slice=4)
+        b = 1 << 20
+        ar = spec.collective_time("all-reduce", b, 4)
+        rs = spec.collective_time("reduce-scatter", b, 4)
+        ag = spec.collective_time("all-gather", b, 4)
+        cp = spec.collective_time("collective-permute", b, 4)
+        assert ar > 0 and ag > 0 and cp > 0
+        # census bytes are the RS op's per-shard OUTPUT (1/n of the
+        # reduced buffer): priced as half the AR ring cost of the FULL
+        # n*b payload, not of b
+        assert rs == pytest.approx(spec.ici_allreduce_time(b * 4, 4) / 2)
+        assert rs > ar / 2
+        assert ag < ar  # allgather moves (n-1)/n vs AR's 2(n-1)/n
+        assert spec.collective_time("all-reduce", b, 1) == 0.0
+
+
+class TestMergeHostTraces:
+    def test_merges_by_host_id(self, tmp_path):
+        from flexflow_tpu.obs.tracer import StepTracer, merge_host_traces
+        td = str(tmp_path)
+        for host in (0, 1):
+            tr = StepTracer(td, host_id=host, run_name="fit")
+            with tr.step():
+                with tr.phase("dispatch"):
+                    pass
+            tr.export()
+        merged = merge_host_traces(td)
+        assert merged is not None
+        data = json.load(open(merged))
+        assert data["metadata"]["merged_hosts"] == [0, 1]
+        pids = {e["pid"] for e in data["traceEvents"]}
+        assert pids == {0, 1}
+
+    def test_repeated_runs_merge_onto_distinct_thread_rows(self, tmp_path):
+        # two runs from the same host into one dir (fit then evaluate,
+        # or a stale trace from an earlier invocation) must land on
+        # separate (pid, tid) rows, not interleave on one thread
+        from flexflow_tpu.obs.tracer import StepTracer, merge_host_traces
+        td = str(tmp_path)
+        for run in ("fit", "evaluate"):
+            tr = StepTracer(td, host_id=0, run_name=run)
+            with tr.step():
+                with tr.phase("dispatch"):
+                    pass
+            tr.export()
+        data = json.load(open(merge_host_traces(td)))
+        spans = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+        assert len({(e["pid"], e["tid"]) for e in spans}) == 2
+        labels = {e["args"]["name"] for e in data["traceEvents"]
+                  if e["name"] == "thread_name"}
+        assert len(labels) == 2 and all(
+            l.startswith(("fit_r", "evaluate_r")) for l in labels)
+
+    def test_empty_dir(self, tmp_path):
+        from flexflow_tpu.obs.tracer import merge_host_traces
+        assert merge_host_traces(str(tmp_path)) is None
+
+
+class TestBenchRatchet:
+    """Satellites: missing-key first run + atomic history write."""
+
+    def _bench(self):
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", os.path.join(repo, "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_first_run_of_new_family_no_keyerror(self):
+        bench = self._bench()
+        hist = {}
+        vs, best, old = bench.ratchet(hist, "new_family:cpu", 100.0,
+                                      {"bs": 8}, "best1x5")
+        assert vs == 1.0
+        assert best == 100.0
+        assert old is None
+        assert hist["new_family:cpu"]["samples_per_s"] == 100.0
+
+    def test_legacy_bare_number_entry(self):
+        bench = self._bench()
+        hist = {"bert_proxy:tpu": 150.0}
+        vs, best, _ = bench.ratchet(hist, "bert_proxy:tpu", 120.0,
+                                    {}, "best3x30")
+        assert vs == pytest.approx(120.0 / 150.0)
+        assert best == 150.0
+
+    def test_ratchet_keeps_best(self):
+        bench = self._bench()
+        hist = {"w:cpu": {"samples_per_s": 200.0, "protocol": "best1x5",
+                          "config": {}}}
+        vs, best, _ = bench.ratchet(hist, "w:cpu", 100.0, {}, "best1x5")
+        assert best == 200.0
+        assert hist["w:cpu"]["samples_per_s"] == 200.0
+
+    def test_save_history_atomic(self, tmp_path):
+        bench = self._bench()
+        path = str(tmp_path / "bench_history.json")
+        bench.save_history(path, {"a": {"samples_per_s": 1.0}})
+        assert json.load(open(path)) == {"a": {"samples_per_s": 1.0}}
+        # overwrite keeps valid JSON and leaves no temp litter
+        bench.save_history(path, {"b": 2})
+        assert json.load(open(path)) == {"b": 2}
+        assert os.listdir(str(tmp_path)) == ["bench_history.json"]
