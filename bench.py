@@ -245,18 +245,47 @@ def ratchet(hist, key, samples_per_s, config, protocol):
     vs = samples_per_s / baseline if baseline else 1.0
     old = entry.get("protocol", protocol) if entry else protocol
     if samples_per_s >= (baseline or 0.0):
-        hist[key] = {"samples_per_s": samples_per_s, "protocol": protocol,
-                     "config": config}
+        # merge over the old entry: sibling ratchets (collective_bytes,
+        # census_ratchet below) live in the same dict and must survive a
+        # new throughput best
+        hist[key] = dict(entry, samples_per_s=samples_per_s,
+                         protocol=protocol, config=config)
     # else: keep the stored best AND its provenance untouched
     return vs, max(samples_per_s, baseline or 0.0), \
         (old if old != protocol else None)
+
+
+def census_ratchet(hist, key, total_bytes, tol=0.01):
+    """Collective BYTE-VOLUME ratchet per workload family (ROADMAP
+    trace-regression gate): lower is better, and unlike samples/s the
+    census is a property of the compiled program — chip weather cannot
+    hide a strategy regression that adds comms. Records the best (lowest)
+    per-device collective bytes per step under ``collective_bytes`` in
+    the same history entry the throughput ratchet uses; returns
+    (regression: bool, baseline_bytes). A new low updates the baseline;
+    anything more than ``tol`` above it is a regression the caller must
+    surface loudly."""
+    entry = hist.get(key)
+    if not isinstance(entry, dict):
+        # legacy bare-number entry: preserve it as the samples/s baseline
+        # (exactly as ratchet() does) instead of clobbering the record
+        entry = ({"samples_per_s": float(entry)}
+                 if isinstance(entry, (int, float)) else {})
+        hist[key] = entry
+    baseline = entry.get("collective_bytes")
+    regression = (baseline is not None
+                  and total_bytes > baseline * (1.0 + tol))
+    if baseline is None or total_bytes < baseline:
+        entry["collective_bytes"] = float(total_bytes)
+    return regression, baseline
 
 
 def emit_obs_artifacts(name, ff, tracer):
     """Per-workload observability emission (only when --trace-dir is
     set): export the step trace, write the compiled-step summary
     artifact, and print ONE census line — to stderr, because the driver
-    parses stdout as the single bench JSON line."""
+    parses stdout as the single bench JSON line. Returns the summary
+    (reused by the census byte ratchet) or None."""
     import traceback
 
     try:
@@ -268,9 +297,32 @@ def emit_obs_artifacts(name, ff, tracer):
         print(f"[obs] {name} collectives: "
               + json.dumps(dict(per_kind=census, total=total)),
               file=sys.stderr)
+        return summary
     except Exception:
         print(f"[obs] {name}: artifact emission failed:\n"
               + traceback.format_exc(), file=sys.stderr)
+        return None
+
+
+def census_bytes_for(name, ff, summary):
+    """Per-device collective bytes the compiled step moves (the obs
+    census total). Reuses a summary already computed for --trace-dir;
+    otherwise pays one AOT lower+compile of the train step.
+    FFS_SKIP_CENSUS=1 opts out (e.g. a time-boxed tunnel run). Returns
+    None when unavailable — the ratchet then simply doesn't engage."""
+    if summary is None and not os.environ.get("FFS_SKIP_CENSUS"):
+        try:
+            from flexflow_tpu.obs import inspect_model_step
+            summary = inspect_model_step(ff)
+        except Exception as e:
+            print(f"[obs] {name}: census inspection failed: {e!r}",
+                  file=sys.stderr)
+            return None
+    if summary is None:
+        return None
+    total = summary.get("collectives_total") or {}
+    b = total.get("bytes")
+    return float(b) if b is not None else None
 
 
 def main():
@@ -292,6 +344,7 @@ def main():
     result = {}
     workloads_out = {}
     protocol_notes = []
+    census_regressions = []
     for name, build, iters in WORKLOADS:
         iters = 5 if on_cpu else iters
         windows = 1 if on_cpu else 3
@@ -305,8 +358,10 @@ def main():
                 tracer = make_tracer(trace_dir, run_name=name)
             sps = time_train(ff, xs, y, iters=iters, windows=windows,
                              tracer=tracer)
+            summary = None
             if tracer is not None and tracer.active:
-                emit_obs_artifacts(name, ff, tracer)
+                summary = emit_obs_artifacts(name, ff, tracer)
+            cbytes = census_bytes_for(name, ff, summary)
         except Exception as e:
             if name == "bert_proxy":
                 raise  # the headline metric must never be silently absent
@@ -316,8 +371,19 @@ def main():
             ff = None
             workloads_out[name] = {"error": f"{type(e).__name__}: {e}"}
             continue
-        vs, best, old_protocol = ratchet(hist, f"{name}:{platform}", sps,
-                                         cfg_dict, protocol)
+        key = f"{name}:{platform}"
+        vs, best, old_protocol = ratchet(hist, key, sps, cfg_dict, protocol)
+        wl = {}
+        if cbytes is not None:
+            # the trace-regression gate (ROADMAP): a strategy change that
+            # adds comms fails LOUDLY here even when chip weather hides
+            # the samples/s slowdown — the census is compile-determined
+            reg, byte_base = census_ratchet(hist, key, cbytes)
+            wl["collective_bytes"] = round(cbytes, 1)
+            if reg:
+                census_regressions.append(
+                    f"{name}: {cbytes:.0f} B/step vs recorded best "
+                    f"{byte_base:.0f}")
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",
@@ -326,10 +392,12 @@ def main():
                 "vs_baseline": round(vs, 4),
                 "best_recorded": round(best, 3),
             })
+            result.update(wl)
         else:
-            workloads_out[name] = {"value": round(sps, 3),
-                                   "vs_baseline": round(vs, 4),
-                                   "best_recorded": round(best, 3)}
+            workloads_out[name] = dict(
+                {"value": round(sps, 3),
+                 "vs_baseline": round(vs, 4),
+                 "best_recorded": round(best, 3)}, **wl)
         if old_protocol:
             protocol_notes.append(f"{name}: {old_protocol} -> {protocol}")
         del ff
@@ -338,6 +406,8 @@ def main():
     except Exception:
         pass
     result["workloads"] = workloads_out
+    if census_regressions:
+        result["census_regressions"] = census_regressions
     if protocol_notes:
         result["protocol_change"] = ("vs_baseline spans protocols — " +
                                      "; ".join(protocol_notes))

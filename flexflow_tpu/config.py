@@ -75,6 +75,14 @@ class FFConfig:
     perform_fusion: bool = True
     profiling: bool = False
     allow_mixed_precision: bool = True  # bf16 matmuls, f32 accumulate/params
+    # conv-family execution layout (flexflow_tpu/layout.py): 'auto' runs
+    # channels-last (NHWC) compute on TPU and keeps the reference NCHW on
+    # CPU; 'nhwc'/'nchw' force it. NCHW stays the API/PCG boundary layout
+    # either way — this only changes how convs execute on the chip.
+    conv_compute_layout: str = "auto"
+    # execution-time Conv+BN(+ReLU) folding for the inference/eval
+    # executables (the reference's fused conv kernels, conv_2d_kernels.cu)
+    fold_conv_bn: bool = True
     # runtime observability (flexflow_tpu/obs): when set, fit/evaluate
     # write per-step Chrome-trace/JSONL artifacts, a compiled-step
     # summary (XLA cost/memory analysis + collective census), and a
@@ -188,6 +196,14 @@ class FFConfig:
                 self.profiling = True
             elif a == "--trace-dir":
                 self.trace_dir = take()
+            elif a == "--conv-layout":
+                v = take().lower()
+                if v not in ("auto", "nhwc", "nchw"):
+                    raise ValueError(
+                        f"--conv-layout expects auto|nhwc|nchw, got {v!r}")
+                self.conv_compute_layout = v
+            elif a == "--disable-conv-bn-fold":
+                self.fold_conv_bn = False
             else:
                 rest.append(a)
             i += 1

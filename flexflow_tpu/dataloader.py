@@ -55,6 +55,21 @@ class SingleDataLoader:
         arr = arr[:usable]
         self.num_batches = usable // self._local_bs
         self.num_samples = self.num_batches * bs  # global count
+        if self._multihost:
+            # agree on num_batches ONCE, up front: unequal per-host dataset
+            # shards would otherwise make ranks issue different numbers of
+            # per-batch collectives and deadlock with no diagnostic
+            # (ADVICE r5). One allgather at construction, zero steady-state
+            # cost.
+            from flexflow_tpu import distributed as _dist
+            counts = _dist.allgather_value(self.num_batches)
+            if len(set(counts)) != 1:
+                raise ValueError(
+                    f"multihost dataloader: per-host num_batches disagree "
+                    f"{counts} (process {_dist.process_index()} computed "
+                    f"{self.num_batches}) — every process must feed "
+                    f"equal-length dataset shards; pad or truncate before "
+                    f"constructing the loader")
         if stage_on_device:
             self.data = jax.device_put(jnp.asarray(arr), sharding)
         else:

@@ -112,6 +112,25 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def allgather_value(value: int):
+    """Every process's copy of a host-side scalar (one collective).
+
+    The agreement check that turns a would-be deadlock into a
+    diagnosis: loop counts derived from per-host data (dataloader
+    ``num_batches``) must match across processes BEFORE anyone enters a
+    per-batch collective, or the job hangs with no message. Single
+    process returns ``[value]`` without touching the backend."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [int(value)]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([int(value)]), tiled=True)
+    return [int(v) for v in np.asarray(gathered).ravel()]
+
+
 # ---------------------------------------------------------------------------
 # per-host batch staging
 

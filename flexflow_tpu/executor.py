@@ -66,6 +66,7 @@ class GraphExecutor:
         compute_dtype=jnp.bfloat16,
         data_axes: Tuple[str, ...] = ("data",),
         final_is_softmax: bool = False,
+        fold_conv_bn: bool = True,
     ):
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
@@ -91,6 +92,7 @@ class GraphExecutor:
         # bandwidth-bound (~620 GB/s marginal), so byte reduction — not a
         # flat-buffer layout — is the lever.
         self.use_master_copy = compute_dtype != jnp.float32
+        self.fold_conv_bn = fold_conv_bn
         self._jit_train = None
         self._jit_eval = None
         self._jit_fwd = {}  # keyed by training flag
@@ -147,50 +149,103 @@ class GraphExecutor:
         }
 
     # ---- forward graph traversal ------------------------------------------
+    def _output_layout(self, guid: int, idx: int) -> str:
+        """Physical layout of a produced value (layout pass metadata on
+        the producing node; absent = NCHW, the boundary contract)."""
+        node = self.by_guid.get(guid)
+        ols = getattr(node, "output_layouts", None) if node is not None else None
+        return ols[idx] if ols and idx < len(ols) else "NCHW"
+
     def run_graph(self, params, state, inputs: Dict[str, jax.Array],
-                  ctx: OpContext):
+                  ctx: OpContext, nodes=None):
         """Evaluate ops in topo order; returns (values, new_state, aux_losses).
 
         aux_losses collects regularizer terms ops emit during forward (e.g.
         the MoE load-balance loss the reference computes inside Aggregate's
         backward, src/ops/aggregate.cu) — they are added to the objective.
+        ``nodes`` overrides the node list (the inference executables run
+        the Conv+BN-folded graph).
         """
         values: Dict[Tuple[int, int], jax.Array] = {}
         new_state: Dict[str, Any] = {}
         aux_losses: List[jax.Array] = []
-        self._run_nodes(self.nodes, params, state, inputs, values,
+        self._run_nodes(nodes if nodes is not None else self.nodes,
+                        params, state, inputs, values,
                         new_state, aux_losses, ctx)
+        # the designated output leaves in the boundary layout whatever the
+        # execution layout of its producer was
+        if self._output_layout(*self.final_ref) == "NHWC":
+            from flexflow_tpu.layout import TO_NCHW
+            values[self.final_ref] = jnp.transpose(
+                values[self.final_ref], TO_NCHW)
         return values, new_state, aux_losses
 
     def _run_nodes(self, nodes, params, state, inputs, values, new_state,
                    aux_losses, ctx: OpContext):
         """Evaluate the given nodes in order, reading/writing the shared
         ``values`` dict (lets the pipeline executor run head/tail subsets
-        around the shard_map'd body)."""
+        around the shard_map'd body).
+
+        Values are stored in their producer's execution layout (the layout
+        pass metadata, flexflow_tpu/layout.py); where a consumer expects
+        the other layout, the transpose materializes HERE, cached per
+        (value, layout) — so after propagation each conv chain pays one
+        boundary pair, not one pair per op."""
+        from flexflow_tpu.layout import TO_NCHW, TO_NHWC
+
+        relayout_cache: Dict[Tuple, jax.Array] = {}
+
+        def fetch(ref, want: str):
+            if ref[0] == "op":
+                have = self._output_layout(ref[1], ref[2])
+                v = values[(ref[1], ref[2])]
+            else:  # graph inputs are staged NCHW (API boundary)
+                have = "NCHW"
+                v = inputs[ref[1]]
+            if want == have or getattr(v, "ndim", 0) != 4:
+                return v
+            key = (tuple(ref), want)
+            if key not in relayout_cache:
+                relayout_cache[key] = jnp.transpose(
+                    v, TO_NHWC if want == "NHWC" else TO_NCHW)
+            return relayout_cache[key]
+
         for node in nodes:
             op = node.op
-            args = []
-            for ref in node.input_refs:
-                if ref[0] == "op":
-                    args.append(values[(ref[1], ref[2])])
-                else:
-                    args.append(inputs[ref[1]])
-            op_params = params.get(op.name, {})
-            if hasattr(op, "init_state"):
-                outs = op.forward(op_params, args, ctx, state=state.get(op.name))
+            in_layouts = getattr(node, "input_layouts", None)
+            args = [
+                fetch(ref, in_layouts[j] if in_layouts else "NCHW")
+                for j, ref in enumerate(node.input_refs)
+            ]
+            sources = getattr(op, "param_sources", None)
+            if sources is not None:
+                # fused execution-time op (FoldedConvBN): reads the
+                # parameter/state subtrees of the ops it folded
+                outs = op.forward(
+                    {s: params.get(s, {}) for s in sources}, args, ctx,
+                    state={s: state.get(s) for s in sources})
+            elif hasattr(op, "init_state"):
+                outs = op.forward(params.get(op.name, {}), args, ctx,
+                                  state=state.get(op.name))
                 if getattr(op, "_new_state", None) is not None:
                     new_state[op.name] = op._new_state
                     op._new_state = None
                 elif op.name in state:
                     new_state[op.name] = state[op.name]
             else:
-                outs = op.forward(op_params, args, ctx)
+                outs = op.forward(params.get(op.name, {}), args, ctx)
             if getattr(op, "_aux_loss", None) is not None:
                 aux_losses.append(op._aux_loss)
                 op._aux_loss = None
+            out_layouts = getattr(node, "output_layouts", None)
             for i, o in enumerate(outs):
                 spec = node.output_specs[i]
                 if spec is not None:
+                    if out_layouts and i < len(out_layouts) \
+                            and out_layouts[i] == "NHWC" \
+                            and getattr(o, "ndim", 0) == 4:
+                        from flexflow_tpu.layout import permute_spec_nhwc
+                        spec = permute_spec_nhwc(spec)
                     o = jax.lax.with_sharding_constraint(
                         o, NamedSharding(self.mesh, spec)
                     )
@@ -297,14 +352,31 @@ class GraphExecutor:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
 
+    def _inference_nodes(self):
+        """Node list the forward-only executables run: eligible Conv2D→
+        BatchNorm(+ReLU) pairs folded into single convolutions
+        (flexflow_tpu/layout.fold_conv_bn — eval BN is an affine transform
+        of running stats, which collapses into the conv weights; the
+        training step keeps the full graph since batch statistics cannot
+        fold). Built once per executor."""
+        if not self.fold_conv_bn:
+            return self.nodes
+        if not hasattr(self, "_folded_nodes"):
+            from flexflow_tpu.layout import fold_conv_bn
+            self._folded_nodes = fold_conv_bn(
+                self.nodes, keep_guids={self.final_ref[0]})
+        return self._folded_nodes
+
     def make_eval_step(self):
         if self._jit_eval is not None:
             return self._jit_eval
+        inf_nodes = self._inference_nodes()
 
         def eval_step(params, state, inputs, labels):
             ctx = OpContext(training=False, compute_dtype=self.compute_dtype,
                             mesh=self.mesh)
-            values, _, _ = self.run_graph(params, state, inputs, ctx)
+            values, _, _ = self.run_graph(params, state, inputs, ctx,
+                                          nodes=inf_nodes)
             logits = values[self.final_ref]
             loss = self._loss_value(logits, labels)
             return loss, logits, self.metrics.compute(logits, labels)
@@ -316,11 +388,13 @@ class GraphExecutor:
     def make_forward(self, training: bool = False):
         if training in self._jit_fwd:
             return self._jit_fwd[training]
+        inf_nodes = None if training else self._inference_nodes()
 
         def fwd(params, state, inputs, rng):
             ctx = OpContext(training=training, rng=rng,
                             compute_dtype=self.compute_dtype, mesh=self.mesh)
-            values, new_state, _ = self.run_graph(params, state, inputs, ctx)
+            values, new_state, _ = self.run_graph(params, state, inputs, ctx,
+                                                  nodes=inf_nodes)
             return values[self.final_ref], new_state
 
         self._jit_fwd[training] = jax.jit(fwd)

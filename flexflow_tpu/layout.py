@@ -1,0 +1,284 @@
+"""Channels-last compute-layout propagation for the conv family.
+
+The API/PCG boundary layout stays NCHW for reference parity (the reference
+is cuDNN-NCHW, src/ops/conv_2d.cc), but the TPU's vector units want the
+channel dim minor: convs executed with ``("NCHW","OIHW","NCHW")`` dimension
+numbers make XLA pad/transpose internally per op, which is where most of
+the conv family's 8x efficiency gap vs matmuls came from (VERDICT Weak #1;
+"A Learned Performance Model for TPUs" and SCALE-Sim, PAPERS.md, both put
+layout among the first-order conv cost terms).
+
+This pass assigns each materialized op an *execution* layout: conv-family
+ops (Conv2D / Pool2D / BatchNorm / GroupNorm) compute via
+``dimension_numbers=("NHWC","HWIO","NHWC")``, layout-oblivious ops
+(elementwise, dropout) pass NHWC values straight through, and Concat
+remaps its channel axis — so the boundary transposes materialize once per
+conv *chain* (at graph inputs and at the first NCHW-only consumer), not
+once per op. The executor (GraphExecutor._run_nodes) inserts the
+transposes exactly where the recorded producer/consumer layouts disagree
+and caches them per value, which makes the once-per-chain property a
+consequence of propagation rather than a separate optimization.
+
+Also here: ``fold_conv_bn`` — the execution-time Conv+BN(+ReLU) fold used
+by the inference/eval executables (the XLA analog of the reference's fused
+conv kernels, src/ops/kernels/conv_2d_kernels.cu).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.ffconst import ActiMode, OperatorType
+
+NCHW = "NCHW"
+NHWC = "NHWC"
+
+# physical-dim permutations between the two layouts
+TO_NHWC = (0, 2, 3, 1)  # NCHW value -> NHWC value
+TO_NCHW = (0, 3, 1, 2)  # NHWC value -> NCHW value
+
+# ops that gain an NHWC execution mode (forward consults self.exec_layout)
+_NHWC_COMPUTE = {
+    OperatorType.CONV2D,
+    OperatorType.POOL2D,
+    OperatorType.BATCHNORM,
+    OperatorType.GROUPNORM,
+}
+
+# layout-oblivious single-input ops: forward is elementwise, so an NHWC
+# value flows through untouched and the chain stays unbroken
+_PASS_THROUGH = {
+    OperatorType.RELU, OperatorType.GELU, OperatorType.SIGMOID,
+    OperatorType.TANH, OperatorType.ELU, OperatorType.EXP,
+    OperatorType.SIN, OperatorType.COS, OperatorType.RSQRT,
+    OperatorType.LOG, OperatorType.IDENTITY, OperatorType.POW,
+    OperatorType.SCALAR_MULTIPLY, OperatorType.SCALAR_ADD,
+    OperatorType.SCALAR_SUB, OperatorType.SCALAR_TRUE_DIV,
+    OperatorType.DROPOUT,
+}
+
+# elementwise binaries: NHWC-transparent when both operands carry the
+# same 4-D shape (broadcast against a differently-ranked operand would
+# change meaning under a permuted layout)
+_BINARY = {
+    OperatorType.EW_ADD, OperatorType.EW_SUB, OperatorType.EW_MUL,
+    OperatorType.EW_DIV, OperatorType.EW_MAX, OperatorType.EW_MIN,
+}
+
+
+def _rank4(shape) -> bool:
+    return len(shape) == 4
+
+
+def layout_enabled(mode: str, on_tpu: bool) -> bool:
+    """'nhwc' forces the pass on, 'nchw' off; 'auto' enables it exactly
+    where it pays — real accelerators. CPU keeps the reference layout so
+    numerics tests exercise the parity path by default."""
+    if mode == "nhwc":
+        return True
+    if mode == "nchw":
+        return False
+    return on_tpu
+
+
+def propagate_layouts(nodes, mode: str = "auto",
+                      on_tpu: bool = False) -> Dict[str, Any]:
+    """Assign execution layouts over a materialized OpNode list.
+
+    Sets, on every node, ``input_layouts``/``output_layouts`` (what its
+    forward consumes/produces) and, on NHWC-computing ops,
+    ``op.exec_layout = "NHWC"``. Returns a summary dict:
+    ``enabled``, ``nhwc_ops`` (ops computing channels-last), and
+    ``transposes`` — the number of boundary transposes the executor will
+    materialize (each chain contributes one entry pair: in + out).
+    """
+    enabled = layout_enabled(mode, on_tpu)
+    layout_of: Dict[Tuple[int, int], str] = {}
+    nhwc_ops = 0
+    boundary: set = set()  # (ref, want) pairs that force a transpose
+
+    for node in nodes:
+        op = node.op
+        in_layouts: List[str] = []
+        have: List[str] = []
+        for ref in node.input_refs:
+            if ref[0] == "op":
+                have.append(layout_of.get((ref[1], ref[2]), NCHW))
+            else:  # graph inputs are staged NCHW (API boundary contract)
+                have.append(NCHW)
+
+        t = op.op_type
+        out_layout = NCHW
+        if enabled and t in _NHWC_COMPUTE and op.input_shapes \
+                and _rank4(op.input_shapes[0]):
+            in_layouts = [NHWC] * len(node.input_refs)
+            out_layout = NHWC
+            op.exec_layout = NHWC
+            nhwc_ops += 1
+        elif enabled and t == OperatorType.CONCAT \
+                and all(_rank4(s) for s in op.input_shapes) \
+                and have and all(h == NHWC for h in have):
+            in_layouts = [NHWC] * len(node.input_refs)
+            out_layout = NHWC
+            op.exec_layout = NHWC
+            nhwc_ops += 1
+        elif enabled and t in _PASS_THROUGH and op.input_shapes \
+                and _rank4(op.input_shapes[0]) and have and have[0] == NHWC:
+            in_layouts = [NHWC] * len(node.input_refs)
+            out_layout = NHWC
+        elif enabled and t in _BINARY and len(op.input_shapes) == 2 \
+                and all(_rank4(s) for s in op.input_shapes) \
+                and op.input_shapes[0] == op.input_shapes[1] \
+                and all(h == NHWC for h in have):
+            in_layouts = [NHWC, NHWC]
+            out_layout = NHWC
+        else:
+            in_layouts = [NCHW] * len(node.input_refs)
+
+        node.input_layouts = in_layouts
+        node.output_layouts = [out_layout] * len(op.output_shapes)
+        for i in range(len(op.output_shapes)):
+            layout_of[(op.guid, i)] = out_layout
+        for ref, want, h in zip(node.input_refs, in_layouts, have):
+            if want != h:
+                boundary.add((tuple(ref), want))
+    return dict(enabled=enabled, nhwc_ops=nhwc_ops,
+                transposes=len(boundary),
+                boundaries=sorted(boundary, key=repr))
+
+
+def permute_spec_nhwc(spec):
+    """PartitionSpec written against the logical NCHW dims, re-expressed
+    for a physically-NHWC value (entry i of the result constrains
+    physical dim i = logical dim TO_NHWC[i])."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(tuple(spec)) + [None] * (4 - len(tuple(spec)))
+    permuted = [entries[d] for d in TO_NHWC]
+    while permuted and permuted[-1] is None:
+        permuted.pop()
+    return P(*permuted)
+
+
+# ---------------------------------------------------------------------------
+# Conv + BN (+ReLU) execution-time folding — inference/eval executables
+
+
+class FoldedConvBN:
+    """Conv2D + BatchNorm(+ReLU) collapsed into one convolution at
+    execution time (eval/inference only — training BN normalizes with
+    batch statistics, which cannot fold into weights).
+
+    With running stats (m, v) and BN affine (g, b):
+      w' = w * g/sqrt(v+eps)   (per output channel)
+      b' = (conv_bias - m) * g/sqrt(v+eps) + b
+    so the folded op runs ONE conv kernel with a fused bias+ReLU epilogue
+    — the reference's fused conv path (conv_2d_kernels.cu) expressed as a
+    weight-space rewrite XLA constant-folds into the step.
+
+    Complementary to ``transforms.fold_conv_batchnorm`` (the OFFLINE
+    pass: user-invoked on an INFERENCE-compiled model, bakes folded
+    weights in and recompiles): this fold is automatic, traced fresh
+    each eval step from the live params/running stats, so a model that
+    keeps TRAINING (and updating BN stats) still gets fused eval.
+
+    The op reads both source ops' parameter subtrees; the executor feeds
+    them via ``param_sources`` (see GraphExecutor._run_nodes).
+    """
+
+    op_type = OperatorType.CONV2D
+
+    def __init__(self, conv_op, bn_op):
+        self.conv = conv_op
+        self.bn = bn_op
+        self.name = f"{conv_op.name}+{bn_op.name}"
+        self.guid = bn_op.guid  # consumers reference the BN output
+        self.input_shapes = list(conv_op.input_shapes)
+        self.output_shapes = list(bn_op.output_shapes)
+        self.dtype = conv_op.dtype
+        self.param_sources = (conv_op.name, bn_op.name)
+
+    @property
+    def exec_layout(self):
+        return getattr(self.conv, "exec_layout", NCHW)
+
+    def output_dim_roles(self):
+        return self.bn.output_dim_roles()
+
+    def flops(self):
+        return self.conv.flops()
+
+    def params_elems(self):
+        return 0  # reads its sources' params; owns none
+
+    def forward(self, params, inputs, ctx, state=None):
+        import jax.numpy as jnp
+        from jax import lax
+
+        (x,) = inputs
+        cp = params.get(self.conv.name, {})
+        bp = params.get(self.bn.name, {})
+        st = (state or {}).get(self.bn.name) or {}
+        mean = st["mean"].astype(jnp.float32)
+        var = st["var"].astype(jnp.float32)
+        inv = lax.rsqrt(var + self.bn.eps) * bp["scale"].astype(jnp.float32)
+        w = cp["kernel"].astype(jnp.float32) * inv[:, None, None, None]
+        cb = cp.get("bias")
+        base = cb.astype(jnp.float32) if cb is not None else 0.0
+        b = (base - mean) * inv + bp["bias"].astype(jnp.float32)
+        act = ActiMode.AC_MODE_RELU if self.bn.relu else ActiMode.AC_MODE_NONE
+        return [self.conv._conv_forward(w, b, x, ctx, act)]
+
+    def __repr__(self):
+        return f"FoldedConvBN({self.name})"
+
+
+def fold_conv_bn(nodes, keep_guids=()):
+    """Fold eligible Conv2D→BatchNorm pairs in an OpNode list.
+
+    Eligible: the BN's sole input is a Conv2D output that nothing else
+    consumes (and whose guid is not in ``keep_guids`` — e.g. the
+    designated model output), and the conv carries no activation of its
+    own (the BN owns the ReLU). Returns a NEW node list; the input list
+    is never mutated, so the training executables keep the full graph.
+    """
+    from flexflow_tpu.executor import OpNode
+    from flexflow_tpu.ops.conv import BatchNorm, Conv2D
+
+    consumers: Dict[Tuple[int, int], int] = {}
+    for node in nodes:
+        for ref in node.input_refs:
+            if ref[0] == "op":
+                k = (ref[1], ref[2])
+                consumers[k] = consumers.get(k, 0) + 1
+    by_guid = {n.op.guid: n for n in nodes}
+    folded_conv_guids = set()
+    replacements: Dict[int, OpNode] = {}  # bn guid -> fused node
+    for node in nodes:
+        op = node.op
+        if not isinstance(op, BatchNorm):
+            continue
+        ref = node.input_refs[0]
+        if ref[0] != "op" or ref[2] != 0:
+            continue
+        prod = by_guid.get(ref[1])
+        if prod is None or not isinstance(prod.op, Conv2D):
+            continue
+        if prod.op.activation != ActiMode.AC_MODE_NONE:
+            continue
+        if consumers.get((ref[1], 0), 0) != 1 or ref[1] in keep_guids:
+            continue
+        fused = OpNode(FoldedConvBN(prod.op, op), list(prod.input_refs))
+        fused.output_specs = list(node.output_specs)
+        fused.input_layouts = list(getattr(prod, "input_layouts", []))
+        fused.output_layouts = list(getattr(node, "output_layouts", []))
+        replacements[op.guid] = fused
+        folded_conv_guids.add(prod.op.guid)
+    if not replacements:
+        return nodes
+    out = []
+    for node in nodes:
+        if node.op.guid in folded_conv_guids:
+            continue  # conv body now lives inside the fused node
+        out.append(replacements.get(node.op.guid, node))
+    return out

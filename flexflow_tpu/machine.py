@@ -104,6 +104,13 @@ class MachineSpec:
     ici_latency: float = 1e-6
     dcn_latency: float = 10e-6
     mxu_efficiency: float = 0.55  # achieved fraction of peak on real shapes
+    # conv-class asymptote: convs don't reach matmul-grade MXU utilization
+    # even channels-last (im2col padding, halo reads, ragged spatial
+    # extents) — the search priced them at mxu_efficiency and every conv
+    # cost it produced was ~5x optimistic (inception_proxy measured ~7%
+    # MFU, bench_history). Calibrate from scripts/roofline.py per-class
+    # aggregates; measured per-op tables still override the analytic model.
+    conv_efficiency: float = 0.35
     min_op_time: float = 5e-7     # per-kernel dispatch overhead (seconds)
     # Arbitrary inter-slice fabric (the reference NetworkedMachineModel's
     # role, simulator.h:515 + network.cc ECMP routing, re-expressed
@@ -143,6 +150,7 @@ class MachineSpec:
         "dcn_bw": ("dcn_bw", float),
         "dcn_latency": ("dcn_latency", float),
         "mxu_efficiency": ("mxu_efficiency", float),
+        "conv_efficiency": ("conv_efficiency", float),
         "min_op_time": ("min_op_time", float),
         # per-slice ICI torus extents: JSON list or "4 2" in key=value form
         "torus": ("torus",

@@ -688,8 +688,19 @@ class FFModel:
         data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
         axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
-                           final_is_softmax=self._final_is_softmax)
+                           final_is_softmax=self._final_is_softmax,
+                           fold_conv_bn=cfg.fold_conv_bn)
+        # conv-family execution layout (flexflow_tpu/layout.py): NCHW stays
+        # the API/PCG boundary, but on TPU the conv family computes
+        # channels-last with boundary transposes hoisted to chain edges.
+        # The pipeline executor keeps NCHW (its shard_map'd body stacks
+        # block params; conv graphs don't pipeline today).
+        from flexflow_tpu.layout import propagate_layouts
+        self._layout_args = dict(
+            mode=getattr(cfg, "conv_compute_layout", "auto"),
+            on_tpu=self.machine_spec.chip != "cpu-sim")
         if axes_now.get("pipe", 1) > 1:
+            self.layout_info = dict(enabled=False, nhwc_ops=0, transposes=0)
             # GPipe lowering: the search picked a pipe mesh (or the user
             # passed one explicitly) — the repeated-block body executes as
             # an SPMD pipeline (parallel/pipeline_exec.py)
@@ -715,6 +726,7 @@ class FFModel:
                 microbatches=int(pinfo.get("microbatches") or 0),
                 **exec_kwargs)
         else:
+            self.layout_info = propagate_layouts(nodes, **self._layout_args)
             self.executor = GraphExecutor(
                 nodes, input_names, final_ref, self.mesh, loss_type,
                 self.metrics, self.optimizer, **exec_kwargs)
@@ -1046,16 +1058,20 @@ class FFModel:
         if self._declared_seq_cache != -1:
             return self._declared_seq_cache
         from flexflow_tpu.ops.base import DimRole
-        found = None
-        for node in self.executor.nodes:
+        # collect EVERY SEQ-role extent: a graph whose ops disagree on the
+        # sequence length (e.g. encoder/decoder cross-attention) has no
+        # single bucketable extent — run full-length rather than slicing
+        # against whichever op happened to iterate last (ADVICE r5)
+        found = {
+            shp[d]
+            for node in self.executor.nodes
             for shp, roles in zip(node.op.output_shapes,
-                                  node.op.output_dim_roles()):
-                for d, r in enumerate(roles):
-                    if r == DimRole.SEQ:
-                        found = shp[d]
-                        break
-        self._declared_seq_cache = found
-        return found
+                                  node.op.output_dim_roles())
+            for d, r in enumerate(roles)
+            if r == DimRole.SEQ
+        }
+        self._declared_seq_cache = found.pop() if len(found) == 1 else None
+        return self._declared_seq_cache
 
     def _seq_bucket(self, seq_length: Optional[int]) -> Optional[int]:
         """Bucketed static length for an iteration's seq_length: the next
@@ -1109,27 +1125,52 @@ class FFModel:
                 overrides[layer.name] = tuple(shp)
         nodes, input_names, tensor_ref = self._materialize_nodes(overrides)
         final_ref = self._select_final_ref(nodes, tensor_ref)
-        # parameter shapes must be sequence-independent; a mismatch means
+        # parameter SHAPES must be sequence-independent; a mismatch means
         # dim 1 of some input was NOT the sequence (e.g. an auxiliary
         # (B, S)-shaped feature input whose extent coincides) and slicing
-        # it would silently corrupt training — refuse instead
-        full_elems = {n.op.guid: n.op.params_elems()
-                      for n in self.executor.nodes}
+        # it would silently corrupt training — refuse instead. Shapes via
+        # eval_shape, not element counts: a parameter that reshapes at the
+        # bucketed length while keeping its element count must still trip
+        # the guard (ADVICE r5).
+        def _shapes(op):
+            # None (not {}) when init_params cannot be abstractly
+            # evaluated, so an eval_shape failure falls back to the
+            # element-count guard instead of silently comparing {} == {}
+            try:
+                tree = jax.eval_shape(op.init_params, jax.random.PRNGKey(0))
+            except Exception:
+                return None
+            return {k: tuple(v.shape) for k, v in tree.items()}
+
+        full_shapes = {n.op.guid: _shapes(n.op)
+                       for n in self.executor.nodes}
         for n in nodes:
-            if full_elems.get(n.op.guid, n.op.params_elems()) \
-                    != n.op.params_elems():
+            mine = _shapes(n.op)
+            ref = full_shapes.get(n.op.guid, mine)
+            if ref is None or mine is None:
+                full_node = self.executor.by_guid.get(n.op.guid)
+                mismatch = (full_node is not None and
+                            full_node.op.params_elems()
+                            != n.op.params_elems())
+            else:
+                mismatch = ref != mine
+            if mismatch:
                 raise NotImplementedError(
                     f"seq_length buckets: op '{n.op.name}' changes "
                     f"parameter shape at the bucketed length — an input "
                     f"whose dim 1 coincides with the sequence extent is "
                     f"not actually a sequence; run full-length instead")
         apply_strategy(nodes, self.strategy, self.mesh)
+        from flexflow_tpu.layout import propagate_layouts
+        propagate_layouts(nodes, **getattr(
+            self, "_layout_args", dict(mode="nchw", on_tpu=False)))
         full = self.executor
         ex = GraphExecutor(nodes, input_names, final_ref, self.mesh,
                            self.loss_type, self.metrics, self.optimizer,
                            compute_dtype=full.compute_dtype,
                            data_axes=full.data_axes,
-                           final_is_softmax=self._final_is_softmax)
+                           final_is_softmax=self._final_is_softmax,
+                           fold_conv_bn=full.fold_conv_bn)
         ex.comp_mode = full.comp_mode
         self._seq_execs[bucket] = ex
         return ex

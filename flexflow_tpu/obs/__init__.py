@@ -26,6 +26,12 @@ from flexflow_tpu.obs.inspect import (
     model_context,
 )
 from flexflow_tpu.obs.registry import CounterRegistry, get_registry
+from flexflow_tpu.obs.roofline import (
+    class_aggregates,
+    finish_aggregates,
+    format_markdown,
+    roofline_report,
+)
 from flexflow_tpu.obs.tracer import (
     NULL_TRACER,
     NullTracer,
@@ -45,6 +51,10 @@ __all__ = [
     "model_context",
     "CounterRegistry",
     "get_registry",
+    "class_aggregates",
+    "finish_aggregates",
+    "format_markdown",
+    "roofline_report",
     "NULL_TRACER",
     "NullTracer",
     "StepTracer",

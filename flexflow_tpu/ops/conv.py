@@ -1,10 +1,15 @@
 """Conv2D / Pool2D / BatchNorm / Flat.
 
 Analog of src/ops/conv_2d.cc, pool_2d.cc, batch_norm.cc, flat.cc and their
-cuDNN kernels. Layout note: the reference is NCHW (cuDNN); TPUs prefer
-NHWC for vectorization, but we keep NCHW at the API boundary for parity
-and let XLA pick internal layouts — lax.conv_general_dilated takes
-explicit dimension_numbers so no transposes are materialized.
+cuDNN kernels. Layout note: the reference is NCHW (cuDNN) and NCHW stays
+the API/PCG boundary layout for parity, but "let XLA pick internal
+layouts" measured ~7% MFU vs BERT's 60% on the chip (VERDICT Weak #1) —
+so each op also carries an NHWC *execution* mode (``self.exec_layout``,
+assigned by the compile-time layout pass, flexflow_tpu/layout.py) that
+computes via ``dimension_numbers=("NHWC","HWIO","NHWC")`` with the
+boundary transposes hoisted to conv-chain edges. Parameters stay in the
+reference OIHW layout either way, so checkpoints and strategy files are
+layout-independent.
 """
 
 from __future__ import annotations
@@ -54,7 +59,26 @@ class Conv2D(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         (x,) = inputs
-        w = params["kernel"].astype(ctx.compute_dtype)
+        return [self._conv_forward(params["kernel"],
+                                   params.get("bias") if self.use_bias
+                                   else None,
+                                   x, ctx, self.activation)]
+
+    def _conv_forward(self, kernel, bias, x, ctx: OpContext, activation):
+        """Shared conv core: kernel arrives OIHW (the parameter layout),
+        ``bias`` may be None, the bias+activation epilogue is fused into
+        the same XLA computation. Also the execution body of the
+        Conv+BN(+ReLU) fold (layout.FoldedConvBN)."""
+        layout = getattr(self, "exec_layout", "NCHW")
+        w = kernel.astype(ctx.compute_dtype)
+        if layout == "NHWC":
+            # OIHW -> HWIO; a pure device-side relayout of the weights XLA
+            # folds into its own kernel prologue — far cheaper than the
+            # per-activation transposes the NCHW dimension numbers imply
+            w = jnp.transpose(w, (2, 3, 1, 0))
+            dn = ("NHWC", "HWIO", "NHWC")
+        else:
+            dn = ("NCHW", "OIHW", "NCHW")
         # no preferred_element_type: conv_general_dilated's transpose rule
         # rejects mixed (bf16 operand, f32 cotangent) convs under autodiff;
         # the TPU MXU accumulates bf16 convs in f32 internally regardless
@@ -63,12 +87,13 @@ class Conv2D(Op):
             w,
             window_strides=self.stride,
             padding=[(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dn,
             feature_group_count=self.groups,
         ).astype(jnp.float32)
-        if self.use_bias:
-            y = y + params["bias"][None, :, None, None]
-        return [apply_activation(y, self.activation).astype(x.dtype)]
+        if bias is not None:
+            y = y + (bias if layout == "NHWC"
+                     else bias[None, :, None, None])
+        return apply_activation(y, activation).astype(x.dtype)
 
     def output_dim_roles(self):
         return [(DimRole.SAMPLE, DimRole.CHANNEL, DimRole.OTHER, DimRole.OTHER)]
@@ -103,9 +128,16 @@ class Pool2D(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         (x,) = inputs
-        window = (1, 1, *self.kernel)
-        strides = (1, 1, *self.stride)
-        pads = ((0, 0), (0, 0), (self.padding[0], self.padding[0]), (self.padding[1], self.padding[1]))
+        hw_pad = ((self.padding[0], self.padding[0]),
+                  (self.padding[1], self.padding[1]))
+        if getattr(self, "exec_layout", "NCHW") == "NHWC":
+            window = (1, *self.kernel, 1)
+            strides = (1, *self.stride, 1)
+            pads = ((0, 0), *hw_pad, (0, 0))
+        else:
+            window = (1, 1, *self.kernel)
+            strides = (1, 1, *self.stride)
+            pads = ((0, 0), (0, 0), *hw_pad)
         if self.pool_type == PoolType.POOL_MAX:
             y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
         else:
@@ -144,9 +176,15 @@ class BatchNorm(Op):
 
     def forward(self, params, inputs, ctx: OpContext, state=None):
         (x,) = inputs
+        nhwc = getattr(self, "exec_layout", "NCHW") == "NHWC"
+        axes = (0, 1, 2) if nhwc else (0, 2, 3)
+        # statistics in f32 even under the bf16 master-weight regime: the
+        # variance of a bf16 activation tensor loses most of its mantissa;
+        # the normalize/affine apply below stays in the compute dtype
+        xf = x.astype(jnp.float32)
         if ctx.training:
-            mean = jnp.mean(x, axis=(0, 2, 3))
-            var = jnp.var(x, axis=(0, 2, 3))
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             new_state = None
             if state is not None:
                 new_state = {
@@ -154,15 +192,20 @@ class BatchNorm(Op):
                     "var": self.momentum * state["var"] + (1 - self.momentum) * var,
                 }
         else:
-            mean = state["mean"] if state is not None else jnp.mean(x, axis=(0, 2, 3))
-            var = state["var"] if state is not None else jnp.var(x, axis=(0, 2, 3))
+            mean = state["mean"] if state is not None else jnp.mean(xf, axis=axes)
+            var = state["var"] if state is not None else jnp.var(xf, axis=axes)
             new_state = state
-        inv = lax.rsqrt(var + self.eps) * params["scale"]
-        y = (x - mean[None, :, None, None]) * inv[None, :, None, None] + params["bias"][None, :, None, None]
+        inv = lax.rsqrt(var + self.eps) * params["scale"].astype(jnp.float32)
+        bias = params["bias"].astype(jnp.float32)
+        if not nhwc:
+            mean = mean[None, :, None, None]
+            inv = inv[None, :, None, None]
+            bias = bias[None, :, None, None]
+        y = (xf - mean) * inv + bias
         if self.relu:
             y = jax.nn.relu(y)
         self._new_state = new_state
-        return [y]
+        return [y.astype(x.dtype)]
 
     def output_dim_roles(self):
         return [(DimRole.SAMPLE, DimRole.CHANNEL, DimRole.OTHER, DimRole.OTHER)]

@@ -90,15 +90,24 @@ class GroupNorm(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         (x,) = inputs
-        n, c = x.shape[0], x.shape[1]
         g = self.groups
-        xf = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
-        axes = tuple(range(2, xf.ndim))
+        nhwc = getattr(self, "exec_layout", "NCHW") == "NHWC"
+        if nhwc:
+            # channels-last: split the minor dim into (g, c/g); each
+            # group normalizes over (*spatial, c/g)
+            n, c = x.shape[0], x.shape[-1]
+            xf = x.astype(jnp.float32).reshape(x.shape[:-1] + (g, c // g))
+            axes = tuple(range(1, xf.ndim - 2)) + (xf.ndim - 1,)
+        else:
+            n, c = x.shape[0], x.shape[1]
+            xf = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
+            axes = tuple(range(2, xf.ndim))
         mean = jnp.mean(xf, axis=axes, keepdims=True)
         var = jnp.mean((xf - mean) ** 2, axis=axes, keepdims=True)
         y = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).reshape(x.shape)
         if self.affine:
-            shape = (1, c) + (1,) * (x.ndim - 2)
+            shape = ((1,) * (x.ndim - 1) + (c,) if nhwc
+                     else (1, c) + (1,) * (x.ndim - 2))
             y = y * params["scale"].reshape(shape) \
                 + params["bias"].reshape(shape)
         return [y.astype(x.dtype)]

@@ -30,7 +30,13 @@ class Concat(Op):
         return [tuple(out)]
 
     def forward(self, params, inputs, ctx: OpContext):
-        return [jnp.concatenate(inputs, axis=self.axis)]
+        ax = self.axis
+        if getattr(self, "exec_layout", "NCHW") == "NHWC" \
+                and len(self.input_shapes[0]) == 4:
+            # values arrive channels-last (layout pass): remap the logical
+            # NCHW axis onto the physical NHWC dim
+            ax = {0: 0, 1: 3, 2: 1, 3: 2}[ax % 4]
+        return [jnp.concatenate(inputs, axis=ax)]
 
     def output_dim_roles(self):
         return [_default_roles(self.output_shapes[0])]

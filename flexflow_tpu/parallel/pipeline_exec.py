@@ -161,7 +161,10 @@ class PipelineGraphExecutor(GraphExecutor):
         return stage_fn
 
     # ---- graph traversal (head -> pipeline -> tail) -----------------------
-    def run_graph(self, params, state, inputs, ctx: OpContext):
+    def run_graph(self, params, state, inputs, ctx: OpContext, nodes=None):
+        # `nodes` (the base executor's Conv+BN-folded inference list) is
+        # ignored: pipeline bodies are transformer blocks — nothing folds —
+        # and the head/tail partition is fixed at construction
         from flexflow_tpu.parallel.pipeline import pipeline_spmd
 
         values: Dict = {}

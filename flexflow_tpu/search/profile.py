@@ -40,17 +40,40 @@ _CACHE: Dict[str, Tuple[float, float]] = {}
 def op_cost_key(op) -> str:
     """Structural identity of an op config on this platform — two ops with
     identical type/shapes/properties share one measurement (the analog of
-    the reference's *Params hash)."""
+    the reference's *Params hash). The execution layout is part of the
+    identity: an NHWC conv and an NCHW conv are different programs with
+    very different costs (flexflow_tpu/layout.py), so their measurements
+    must never alias."""
     platform = jax.devices()[0].platform
     device = getattr(jax.devices()[0], "device_kind", platform)
-    raw = repr((op.param_key(), platform, device))
+    raw = repr((op.param_key(), getattr(op, "exec_layout", "NCHW"),
+                platform, device))
     return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
 
+def op_io_bytes(op, dtype_size: float = 4.0) -> float:
+    """HBM bytes one forward pass of the op must move: inputs + outputs +
+    parameters, at ``dtype_size`` bytes/element. The denominator of the
+    op's arithmetic intensity in the roofline report
+    (flexflow_tpu/obs/roofline.py) — a lower bound (reads each operand
+    once), matching the roofline model's convention."""
+    elems = sum(float(np.prod(s)) for s in op.input_shapes)
+    elems += sum(float(np.prod(s)) for s in op.output_shapes)
+    elems += float(op.params_elems())
+    return dtype_size * elems
+
+
 def _example_inputs(op, rs: np.random.RandomState) -> List[jax.Array]:
-    """Random inputs honoring the few ops with integral-domain inputs."""
+    """Random inputs honoring the few ops with integral-domain inputs.
+
+    Ops assigned the NHWC execution layout (flexflow_tpu/layout.py)
+    consume physically channels-last values — their example inputs must
+    be NHWC-shaped or the standalone forward rejects the channel count."""
+    nhwc = getattr(op, "exec_layout", "NCHW") == "NHWC"
     arrs = []
     for i, shp in enumerate(op.input_shapes):
+        if nhwc and len(shp) == 4:
+            shp = tuple(shp[d] for d in (0, 2, 3, 1))  # NCHW -> NHWC
         if op.op_type == OperatorType.EMBEDDING:
             vocab = getattr(op, "num_entries", None) or 2
             a = rs.randint(0, max(1, int(vocab)), size=shp).astype(np.float32)
@@ -170,7 +193,8 @@ def _slope_time(loop_fn, args, repeats: int, warmup: int) -> float:
 
 
 def measure_op(op, repeats: int = 3, warmup: int = 1,
-               hbm_bw: float = 0.82e12) -> Tuple[float, float]:
+               hbm_bw: float = 0.82e12,
+               include_bwd: bool = True) -> Tuple[float, float]:
     """Time one op's forward and backward compute on the default device.
 
     Returns (fwd_seconds, bwd_seconds). The op runs inside a jitted
@@ -182,10 +206,17 @@ def measure_op(op, repeats: int = 3, warmup: int = 1,
     launches (model.cu:54-66). Backward is (fwd+bwd slope) - (fwd slope)
     of a value_and_grad over float params/inputs, not assumed 2x forward.
     Raises on ops whose forward cannot run standalone (caller skips them).
+    ``include_bwd=False`` skips the (expensive) backward slope timing
+    entirely and returns the 2x-forward estimate for bwd; fwd-only
+    measurements cache under a distinct key so they never masquerade as
+    measured backward costs.
     """
-    key = op_cost_key(op)
+    key = op_cost_key(op) + ("" if include_bwd else ":fwdonly")
     if key in _CACHE:
         return _CACHE[key]
+    # a full measurement already covers the fwd-only request
+    if not include_bwd and op_cost_key(op) in _CACHE:
+        return _CACHE[op_cost_key(op)]
     rs = np.random.RandomState(0)
     params = op.init_params(jax.random.PRNGKey(0))
     inputs = _example_inputs(op, rs)
@@ -220,7 +251,7 @@ def measure_op(op, repeats: int = 3, warmup: int = 1,
     t_bwd = 2.0 * t_fwd
     has_grad_inputs = any(
         jnp.issubdtype(x.dtype, jnp.floating) for x in inputs)
-    if params or has_grad_inputs:
+    if include_bwd and (params or has_grad_inputs):
         argnums = (0, 1) if params and has_grad_inputs else (
             (0,) if params else (1,))
         vag = jax.value_and_grad(loss, argnums=argnums)

@@ -135,6 +135,10 @@ def machine_to_json(spec, num_devices: int,
         dcn_latency=dcn_latency,
         num_slices=spec.num_slices,
         mxu_efficiency=getattr(spec, "mxu_efficiency", 0.55),
+        # conv-class asymptote (ffs_strategy.hpp node_cost): predicted
+        # conv times track the measured conv-vs-matmul efficiency gap
+        # instead of assuming matmul-grade MXU utilization
+        conv_efficiency=getattr(spec, "conv_efficiency", 0.35),
         min_op_time=getattr(spec, "min_op_time", 5e-7),
         # bf16 activations/grads under mixed precision: collectives move
         # half the nominal f32 bytes (ffs_machine.hpp comm_bytes_factor)

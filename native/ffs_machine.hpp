@@ -35,6 +35,14 @@ struct MachineModel {
   double dcn_latency = 10e-6;
   int num_slices = 1;
   double mxu_efficiency = 0.55;  // achievable fraction of peak on real shapes
+  // Per-op-class efficiency: convs do NOT reach matmul-grade MXU
+  // utilization even channels-last (im2col padding, halo reads, ragged
+  // spatial extents) — pricing them at mxu_efficiency made every conv
+  // strategy the search ranked untrustworthy (ISSUE 2 motivation;
+  // bench_history: inception_proxy ran ~7% MFU while the model assumed
+  // 55%). Calibrate from scripts/roofline.py per-class aggregates;
+  // measured per-op costs still override everything.
+  double conv_efficiency = 0.35;
   double min_op_time = 5e-7;     // floor per fused op (dispatch overhead)
   // Collective payloads relative to the graph's nominal dtype: under the
   // r4 mixed-precision regime activations AND gradients move in bf16
@@ -141,6 +149,7 @@ struct MachineModel {
     m.dcn_latency = j.get("dcn_latency").as_double(m.dcn_latency);
     m.num_slices = static_cast<int>(j.get("num_slices").as_int(1));
     m.mxu_efficiency = j.get("mxu_efficiency").as_double(m.mxu_efficiency);
+    m.conv_efficiency = j.get("conv_efficiency").as_double(m.conv_efficiency);
     m.min_op_time = j.get("min_op_time").as_double(m.min_op_time);
     m.comm_bytes_factor =
         j.get("comm_bytes_factor").as_double(m.comm_bytes_factor);
@@ -238,16 +247,19 @@ struct MachineModel {
   }
 
   // Shape-aware achievable fraction of peak for an (M,N,K) matmul:
-  // the calibrated global scalar (mxu_efficiency, the large-shape
-  // asymptote) scaled by tile fill on all three dims. Large multiples
-  // of 128 reproduce the flat model exactly; narrow/ragged shapes —
-  // a per-chip batch of a few rows, a 96-channel conv — pay the
-  // padding the flat model hid (VERDICT r4 Weak #4: "every unmeasured
-  // op inherits the single scalar").
-  double matmul_efficiency(double M, double N, double K) const {
+  // the calibrated per-class scalar (``asymptote``; defaults to
+  // mxu_efficiency, the large-shape asymptote) scaled by tile fill on
+  // all three dims. Large multiples of 128 reproduce the flat model
+  // exactly; narrow/ragged shapes — a per-chip batch of a few rows, a
+  // 96-channel conv — pay the padding the flat model hid (VERDICT r4
+  // Weak #4: "every unmeasured op inherits the single scalar"). Conv
+  // callers pass conv_efficiency as the asymptote.
+  double matmul_efficiency(double M, double N, double K,
+                           double asymptote = -1.0) const {
+    if (asymptote <= 0) asymptote = mxu_efficiency;
     double u = tile_util(M, 128.0) * tile_util(N, 128.0) *
                tile_util(K, 128.0);
-    return mxu_efficiency * std::max(0.05, u);
+    return asymptote * std::max(0.05, u);
   }
 
   // Roofline: time for `flop` FLOPs touching `bytes` of HBM on one chip.

@@ -684,7 +684,14 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
             (double)(os[2] * os[3]);
       }
     }
-    if (M > 0 && N > 0 && K > 0) eff = m.matmul_efficiency(M, N, K);
+    // conv-class asymptote: measured conv MFU sits far below matmul MFU
+    // even channels-last (per-op-class calibration, ffs_machine.hpp)
+    double asym = (n.type == "CONV2D") ? m.conv_efficiency
+                                       : m.mxu_efficiency;
+    if (M > 0 && N > 0 && K > 0)
+      eff = m.matmul_efficiency(M, N, K, asym);
+    else if (n.type == "CONV2D")
+      eff = m.conv_efficiency;  // geometry unavailable: flat conv class
   }
   nc.fwd = mfwd ? std::max(*mfwd / div, m.min_op_time)
                 : m.compute_time(flop, bytes, n.dtype_size, eff);
