@@ -18,6 +18,8 @@ Layer map (cf. reference SURVEY.md §1):
   L9 models         -> flexflow_tpu/models
   observability     -> flexflow_tpu/obs (step tracing, HLO cost/collective
                        census, search-drift calibration; --trace-dir)
+  static analysis   -> flexflow_tpu/analysis (fflint: pass-based strategy
+                       & graph verifier; --lint / scripts/fflint.py)
 
 ``__version__`` (from flexflow_tpu/version.py) is stamped into every
 trace/census/drift artifact header the obs subsystem writes.
@@ -36,6 +38,7 @@ from flexflow_tpu.ffconst import (
     PoolType,
 )
 from flexflow_tpu.config import FFConfig
+from flexflow_tpu.analysis import LintReport, Severity, lint_model
 from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape, Tensor
 from flexflow_tpu.machine import MachineSpec, MachineView
 from flexflow_tpu.model import FFModel
@@ -62,6 +65,9 @@ __all__ = [
     "ParameterSyncType",
     "PoolType",
     "FFConfig",
+    "LintReport",
+    "Severity",
+    "lint_model",
     "ParallelDim",
     "ParallelTensorShape",
     "Tensor",

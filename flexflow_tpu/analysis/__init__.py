@@ -1,0 +1,35 @@
+"""fflint — static strategy & graph verifier for the PCG, searched
+strategies, and emitted HLO.
+
+A pass-based static-analysis framework that verifies a compiled model's
+parallelization BEFORE anything runs: sharding legality against the
+mesh, the collective census the strategy implies vs what the simulator
+priced (and, optionally, what XLA emitted), layout and dtype policy,
+cross-host collective ordering, and graph hygiene. Entry points:
+
+* ``lint_model(ff)`` — lint a compiled FFModel (static passes only);
+  ``lint_model(ff, hlo=True)`` additionally compiles the step and runs
+  the emitted-HLO checks;
+* ``model.compile(..., lint="warn"|"error")`` / ``FFConfig --lint`` —
+  inline linting at compile time;
+* ``scripts/fflint.py --model <zoo> [--json] [--hlo]`` — the CLI.
+
+Rule catalog: README.md §fflint.
+"""
+
+from flexflow_tpu.analysis.diagnostics import (Diagnostic, LintReport,
+                                               Severity)
+from flexflow_tpu.analysis.orchestrator import (LintContext, SkipPass,
+                                                all_passes, lint_model,
+                                                run_passes)
+
+__all__ = [
+    "Diagnostic",
+    "LintReport",
+    "Severity",
+    "LintContext",
+    "SkipPass",
+    "all_passes",
+    "lint_model",
+    "run_passes",
+]

@@ -1,0 +1,178 @@
+"""fflint orchestrator: run the pass pipeline over a compiled model.
+
+The verifier runs over three progressively-more-expensive views of the
+same training program:
+
+(a) the materialized PCG (``OpNode`` list + mesh + strategy) — every
+    pass reads this; pure static analysis, no device work;
+(b) the searched strategy's priced collective set (native simulator
+    replay) — the collective-inference pass prices the strategy when
+    the native core is available;
+(c) the optimized HLO of the compiled step — optional (``hlo=``),
+    because lower+compile is minutes of XLA on a real chip; when given,
+    the emitted collective census joins the diff and the multihost
+    pass can compare per-host programs.
+
+A pass that cannot run records a skip reason in ``report.passes``
+instead of pretending it found nothing, and a pass that crashes
+becomes an FFL000 diagnostic rather than killing the lint run.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.analysis.diagnostics import LintReport, error
+
+
+class SkipPass(Exception):
+    """Raised by a pass that cannot run in this context (e.g. the
+    multihost pass with a single program); the reason lands in
+    ``report.passes`` so skipped != clean."""
+
+
+class LintContext:
+    """Everything a pass may read. ``ff`` is optional — hand-built
+    contexts (tests, strategy files without a model) carry nodes/mesh/
+    strategy directly; passes needing the model degrade or skip."""
+
+    def __init__(self, nodes, mesh, strategy=None, machine_spec=None,
+                 config=None, final_ref: Optional[Tuple[int, int]] = None,
+                 ff=None, hlo_text: Optional[str] = None,
+                 hlo_per_host: Optional[List[str]] = None,
+                 priced: Optional[Dict[str, float]] = None,
+                 emitted: Optional[Dict[str, float]] = None,
+                 searched: Optional[bool] = None):
+        self.nodes = nodes
+        self.mesh = mesh
+        self.strategy = strategy or {}
+        self.machine_spec = machine_spec
+        self.config = config
+        self.final_ref = tuple(final_ref) if final_ref is not None else None
+        self.ff = ff
+        self.hlo_text = hlo_text
+        self.hlo_per_host = hlo_per_host
+        self.priced = priced      # simulator-priced {kind: bytes}, lazy
+        self.emitted = emitted    # HLO-census {kind: bytes}, lazy
+        # whether the strategy came from the auto-parallelization search
+        # (the calibration pass only meaningfully audits searched runs)
+        if searched is None:
+            searched = bool(ff is not None
+                            and isinstance(getattr(ff, "search_info", None),
+                                           dict))
+        self.searched = searched
+        self.by_guid = {n.op.guid: n for n in nodes}
+        self._consumers = None
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        if self.mesh is None:
+            return {}
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+    def consumers(self) -> Dict[Tuple[int, int], List]:
+        """(producer guid, out idx) -> list of (consumer node, input pos).
+        Memoized — the graph is not mutated during a lint run, and
+        several passes (hygiene, layout, dtype) walk this map."""
+        if self._consumers is None:
+            out: Dict[Tuple[int, int], List] = {}
+            for node in self.nodes:
+                for j, ref in enumerate(node.input_refs):
+                    if ref[0] == "op":
+                        out.setdefault((ref[1], ref[2]), []).append((node, j))
+            self._consumers = out
+        return self._consumers
+
+    def ensure_priced(self) -> Optional[Dict[str, float]]:
+        """Simulator-priced collectives for the model's strategy (native
+        replay); None when no model / native core is attached."""
+        if self.priced is not None:
+            return self.priced
+        if self.ff is None:
+            return None
+        from flexflow_tpu.search.native import available
+        if not available():
+            return None
+        from flexflow_tpu.search.validate import priced_collectives
+        self.priced = priced_collectives(self.ff)
+        return self.priced
+
+    def ensure_emitted(self) -> Optional[Dict[str, float]]:
+        """Collectives emitted in the optimized HLO (requires hlo_text)."""
+        if self.emitted is not None:
+            return self.emitted
+        if not self.hlo_text:
+            return None
+        from flexflow_tpu.search.validate import emitted_collectives
+        self.emitted = emitted_collectives(self.hlo_text)
+        return self.emitted
+
+
+def all_passes():
+    """The shipped pass pipeline, in execution order (cheap graph-shape
+    checks first so their findings frame the expensive ones)."""
+    from flexflow_tpu.analysis.passes.calibration import CalibrationPass
+    from flexflow_tpu.analysis.passes.collectives import CollectiveInferencePass
+    from flexflow_tpu.analysis.passes.dtype import DtypePolicyPass
+    from flexflow_tpu.analysis.passes.hygiene import GraphHygienePass
+    from flexflow_tpu.analysis.passes.layout import LayoutConsistencyPass
+    from flexflow_tpu.analysis.passes.multihost import MultihostOrderPass
+    from flexflow_tpu.analysis.passes.sharding import ShardingLegalityPass
+    return [
+        GraphHygienePass(),
+        ShardingLegalityPass(),
+        LayoutConsistencyPass(),
+        DtypePolicyPass(),
+        CollectiveInferencePass(),
+        MultihostOrderPass(),
+        CalibrationPass(),
+    ]
+
+
+def run_passes(ctx: LintContext, passes=None) -> LintReport:
+    report = LintReport()
+    report.context = dict(
+        num_ops=len(ctx.nodes),
+        mesh_axes=ctx.axis_sizes,
+        searched=ctx.searched,
+        hlo="yes" if ctx.hlo_text else "no",
+    )
+    for p in passes if passes is not None else all_passes():
+        try:
+            report.extend(p.run(ctx), p.name)
+            report.passes[p.name] = "ok"
+        except SkipPass as e:
+            report.passes[p.name] = f"skipped: {e}"
+        except Exception as e:  # a broken pass must not kill the lint run
+            report.passes[p.name] = f"crashed: {e!r}"
+            report.extend([error(
+                "FFL000", f"pass crashed: {e!r}",
+                hint="fflint internal error — report with the model config"
+            )], p.name)
+    return report
+
+
+def lint_model(ff, hlo=None, passes=None,
+               hlo_per_host: Optional[List[str]] = None) -> LintReport:
+    """Lint a compiled FFModel.
+
+    ``hlo``: None runs the static passes only; ``True`` lowers+compiles
+    the train step to include the emitted-HLO checks (expensive — one
+    full XLA compile); a string is used as the optimized-HLO text
+    directly (e.g. from a saved dump or a prior ``train_step_hlo``).
+    """
+    if ff.executor is None:
+        raise ValueError("lint_model needs a compiled model — call "
+                         "model.compile(...) first")
+    hlo_text = None
+    if hlo is True:
+        from flexflow_tpu.search.validate import train_step_hlo
+        hlo_text = train_step_hlo(ff)
+    elif isinstance(hlo, str):
+        hlo_text = hlo
+    ctx = LintContext(
+        nodes=ff.executor.nodes, mesh=ff.mesh, strategy=ff.strategy,
+        machine_spec=ff.machine_spec, config=ff.config,
+        final_ref=ff.executor.final_ref, ff=ff, hlo_text=hlo_text,
+        hlo_per_host=hlo_per_host)
+    return run_passes(ctx, passes=passes)

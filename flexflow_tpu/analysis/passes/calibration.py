@@ -1,0 +1,114 @@
+"""calibration: is the cost model the search just used trustworthy?
+
+The recalibration loop (scripts/calibrate.py --ingest-drift) folds
+observed predicted-vs-measured drift from real training runs into
+CALIBRATION.json as per-op-type correction factors, which
+search/profile.py applies to the measured tables it feeds the native
+simulator. This pass audits a searched strategy against that state:
+
+* FFL701  the search priced ops with the analytic roofline only — no
+          microbenchmarks (--search-measure-ops) and no ingested drift
+          corrections exist for this platform;
+* FFL702  op types in this graph carry no correction factor while other
+          types do (their relative pricing is the raw analytic model —
+          exactly the asymmetry that mis-ranks candidate strategies);
+* FFL703  calibration data exists but was taken on a different
+          platform/device — stale for this machine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic, warning
+
+
+def calibration_path() -> str:
+    env = os.environ.get("FFS_CALIBRATION_FILE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))), "CALIBRATION.json")
+
+
+def load_calibration() -> Optional[Dict[str, Any]]:
+    try:
+        with open(calibration_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class CalibrationPass:
+    name = "calibration"
+
+    def run(self, ctx) -> List[Diagnostic]:
+        if not ctx.searched:
+            from flexflow_tpu.analysis.orchestrator import SkipPass
+            raise SkipPass("strategy is heuristic (not searched) — "
+                           "cost-model calibration does not gate it")
+        diags: List[Diagnostic] = []
+        cal = load_calibration()
+        # op_corrections is platform-first: {platform: {op type: entry}}
+        # (scripts/calibrate.py derive_op_corrections) — only the
+        # current platform's bucket ever scales measured tables
+        all_corrections = (cal or {}).get("op_corrections", {})
+        platform = _current_platform()
+        corrections = (all_corrections.get(platform, {})
+                       if platform is not None else {})
+        measured_ran = bool(ctx.config is not None
+                            and getattr(ctx.config, "search_measure_ops",
+                                        False))
+        if not all_corrections and not measured_ran:
+            diags.append(warning(
+                "FFL701",
+                "search priced every op from the analytic roofline: no "
+                "--search-measure-ops microbenchmarks and no ingested "
+                "drift corrections",
+                hint="run a traced fit (--trace-dir) then "
+                     "scripts/calibrate.py --ingest-drift TRACE_DIR to "
+                     "close the loop"))
+            return diags
+        if cal is not None and platform is not None:
+            cal_platform = cal.get("platform")
+            if cal_platform and cal_platform != platform:
+                diags.append(warning(
+                    "FFL703",
+                    f"calibration data is from platform "
+                    f"{cal_platform!r}; this run is on {platform!r}",
+                    hint="re-run scripts/calibrate.py on this machine — "
+                         "cross-platform correction factors mislead the "
+                         "search"))
+        if all_corrections and not corrections:
+            diags.append(warning(
+                "FFL703",
+                f"drift corrections exist only for platform(s) "
+                f"{', '.join(sorted(all_corrections))} — none apply on "
+                f"{platform!r}",
+                hint="re-ingest drift observed on this platform"))
+        if corrections:
+            graph_types = {n.op.op_type.name for n in ctx.nodes
+                           if n.op.flops() > 0}
+            missing = sorted(t for t in graph_types
+                             if t not in corrections)
+            if missing and len(missing) < len(graph_types):
+                diags.append(warning(
+                    "FFL702",
+                    f"no drift correction for op types "
+                    f"{', '.join(missing)} while "
+                    f"{len(graph_types) - len(missing)} other type(s) "
+                    f"are corrected — relative pricing is skewed",
+                    hint="ingest drift from a run containing these ops "
+                         "(scripts/calibrate.py --ingest-drift)"))
+        return diags
+
+
+def _current_platform() -> Optional[str]:
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:
+        return None

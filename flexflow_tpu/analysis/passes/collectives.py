@@ -1,0 +1,263 @@
+"""collective-inference: the static census invariant.
+
+The classic silent failure (SURVEY §7, search/validate.py): a searched
+strategy underperforms its prediction because GSPMD inserted collectives
+the simulator never priced. This pass closes the loop in three layers:
+
+1. *Infer* — derive, from the strategy alone (no compile, no native
+   core), the collective kinds the program must contain: the gradient
+   all-reduce of every data-replicated parameter, the partial-sum psum
+   of every row-parallel contraction, the all-gather behind every
+   Combine/Replicate boundary, the reshard behind every
+   axis-moving Repartition, the ring ppermute of seq-parallel
+   attention, the expert-dispatch all-to-all. This is a LOWER bound:
+   GSPMD may insert more, never less.
+2. *Price* — replay the strategy through the native simulator
+   (validate.priced_collectives) when it is available. An inferred
+   kind the simulator never charged is an FFL204 error: the search
+   compared candidate strategies while blind to a cost this one
+   provably carries.
+3. *Emit* — when the caller supplies the optimized HLO, diff the
+   priced set against the emitted census (validate.diff_collectives):
+   an emitted kind with no priced coverage is the FFL201 error the
+   ROADMAP's "census as a search invariant" item asks for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.analysis.diagnostics import (Diagnostic, error, info,
+                                               warning)
+from flexflow_tpu.ffconst import CompMode, OperatorType
+
+# which priced kinds cover an inferred/emitted kind — the shared
+# definition (XLA AR decomposition, reshard covering permute/a2a) lives
+# next to diff_collectives so both layers always classify alike
+from flexflow_tpu.search.validate import COLLECTIVE_COVER as _COVER
+
+# payloads below this are scalar loss/metric reductions the simulator
+# deliberately does not price — the inference skips them symmetrically
+_MIN_BYTES = float(1 << 12)
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def _spec_degree(spec, axis_sizes) -> int:
+    if spec is None:
+        return 1
+    deg = 1
+    for entry in spec:
+        for ax in _entry_axes(entry):
+            deg *= axis_sizes.get(ax, 1)
+    return deg
+
+
+def _node_param_specs(node, ctx) -> Dict[str, Any]:
+    ps = getattr(node, "param_specs", None)
+    if ps:
+        return ps
+    st = ctx.strategy.get(node.op.guid)
+    return st.param_specs if st is not None else {}
+
+
+def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
+    """{kind: {bytes, sources: [op names]}} the strategy implies.
+
+    Bytes are per-device payloads (the census convention): an
+    all-reduce of a replicated gradient moves the full tensor per
+    device; a reshard moves the shard. Grad/activation payloads use
+    the executor's compute dtype width (bf16 halves them under the
+    master-weight regime, matching the simulator's
+    ``comm_bytes_factor``)."""
+    axis_sizes = ctx.axis_sizes
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def add(kind: str, nbytes: float, src: str):
+        if nbytes < _MIN_BYTES:
+            return
+        e = out.setdefault(kind, dict(bytes=0.0, sources=[]))
+        e["bytes"] += nbytes
+        e["sources"].append(src)
+
+    elem = 4.0
+    training = True
+    if ctx.ff is not None and ctx.ff.executor is not None:
+        elem = float(np.dtype(ctx.ff.executor.compute_dtype).itemsize)
+        training = getattr(ctx.ff.executor, "comp_mode",
+                           CompMode.TRAINING) == CompMode.TRAINING
+    data_deg = 1
+    for ax in ("data", "replica"):
+        data_deg *= axis_sizes.get(ax, 1)
+
+    for node in ctx.nodes:
+        op = node.op
+        nelem = float(op.params_elems())
+        pspecs = _node_param_specs(node, ctx)
+        specs = getattr(node, "output_specs", None) or []
+        spec0 = specs[0] if specs else None
+        if spec0 is None:
+            st = ctx.strategy.get(op.guid)
+            if st is not None and st.output_specs:
+                spec0 = st.output_specs[0]
+        data_sharded = any(
+            ax in ("data", "replica")
+            for entry in (tuple(spec0) if spec0 is not None else ())
+            for ax in _entry_axes(entry))
+        if training and data_deg > 1 and nelem > 0 and data_sharded:
+            # gradient sync: a batch-sharded op's replicated params see
+            # different rows per device, so their grads all-reduce over
+            # the data axes (params sharded over 'data' would
+            # reduce-scatter instead — same priced bucket). A fully
+            # replicated op ("rep" choice) computes identical grads on
+            # every device and needs no sync.
+            add("allreduce", nelem * elem, f"{op.name}:grad")
+        # row-parallel contractions produce partial sums -> psum: a
+        # contraction-dim-sharded kernel (Linear in-dim, attention
+        # head-dim on wo, embedding vocab-dim)
+        psum_axes = ()
+        if op.op_type == OperatorType.LINEAR:
+            psum_axes = _entry_axes(_dim0(pspecs.get("kernel")))
+        elif op.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            psum_axes = _entry_axes(_dim0(pspecs.get("wo")))
+        elif op.op_type == OperatorType.EMBEDDING:
+            psum_axes = _entry_axes(_dim0(pspecs.get("kernel")))
+        if psum_axes:
+            out_bytes = float(np.prod(op.output_shapes[0])) * elem
+            specs = getattr(node, "output_specs", None) or []
+            shard = out_bytes / _spec_degree(specs[0] if specs else None,
+                                             axis_sizes)
+            add("allreduce", shard, f"{op.name}:psum")
+        # explicit PCG resharding boundaries
+        if getattr(op, "is_parallel_op", False):
+            self_bytes = float(np.prod(op.output_shapes[0])) * elem
+            src_spec = _producer_spec(node, ctx)
+            src_deg = _spec_degree(src_spec, axis_sizes)
+            t = op.op_type
+            if t == OperatorType.COMBINE and src_deg > 1:
+                add("allgather", self_bytes, op.name)
+            elif t == OperatorType.REPLICATE and src_deg > 1:
+                add("allgather", self_bytes, op.name)
+            elif t == OperatorType.REPARTITION and src_spec is not None:
+                # moving an axis between dims is an all-to-all reshard
+                d = op.repartition_dim % len(op.output_shapes[0])
+                entries = list(src_spec) + [None] * len(op.output_shapes[0])
+                if op.axis in axis_sizes \
+                        and any(op.axis in _entry_axes(e)
+                                for i, e in enumerate(entries) if i != d):
+                    add("reshard",
+                        self_bytes / axis_sizes[op.axis], op.name)
+            elif t == OperatorType.REDUCTION and src_deg > 1:
+                add("allreduce", self_bytes, op.name)
+        # ring attention: per-step K/V rotation over the seq axis
+        if getattr(op, "seq_parallel", None) and axis_sizes.get("seq", 1) > 1:
+            sp = axis_sizes["seq"]
+            kv_bytes = sum(float(np.prod(s)) for s in op.input_shapes[1:3])
+            add("ppermute", kv_bytes * elem / sp * (3 if training else 1),
+                f"{op.name}:ring")
+        # expert parallelism: token dispatch/combine all-to-all
+        if getattr(op, "expert_parallel", None) \
+                and axis_sizes.get("expert", 1) > 1:
+            add("reshard", float(np.prod(op.output_shapes[0])) * elem,
+                f"{op.name}:dispatch")
+    return out
+
+
+def _dim0(spec):
+    if spec is None:
+        return None
+    entries = tuple(spec)
+    return entries[0] if entries else None
+
+
+def _producer_spec(node, ctx):
+    ref = node.input_refs[0] if node.input_refs else None
+    if not ref or ref[0] != "op":
+        return None
+    prod = ctx.by_guid.get(ref[1])
+    if prod is None:
+        return None
+    specs = getattr(prod, "output_specs", None)
+    if specs is None:
+        st = ctx.strategy.get(ref[1])
+        specs = st.output_specs if st is not None else None
+    return specs[ref[2]] if specs and ref[2] < len(specs) else None
+
+
+class CollectiveInferencePass:
+    name = "collective-inference"
+
+    def run(self, ctx) -> List[Diagnostic]:
+        diags: List[Diagnostic] = []
+        inferred = infer_strategy_collectives(ctx)
+        priced: Optional[Dict[str, float]] = None
+        try:
+            priced = ctx.ensure_priced()
+        except NotImplementedError as e:
+            diags.append(info(
+                "FFL206", f"priced-side diff skipped: {e}",
+                hint="pipeline strategies cannot be replayed through "
+                     "the simulator yet"))
+        except Exception as e:
+            diags.append(warning(
+                "FFL206", f"simulator replay failed: {e!r}",
+                hint="the priced-vs-inferred diff did not run — fix the "
+                     "replay before trusting this strategy's prediction"))
+        emitted = ctx.ensure_emitted()
+
+        if priced is not None:
+            # inferred kind the simulator never charged: the search
+            # compared strategies blind to a cost this one provably has
+            for kind, entry in inferred.items():
+                pb = sum(priced.get(k, 0.0)
+                         for k in _COVER.get(kind, {kind}))
+                if pb <= 0:
+                    srcs = ", ".join(entry["sources"][:4])
+                    diags.append(error(
+                        "FFL204",
+                        f"strategy implies {kind} "
+                        f"({entry['bytes'] / 1e6:.2f} MB from {srcs}) but "
+                        f"the simulator priced none",
+                        hint="the native cost model is blind to this "
+                             "collective — its strategy ranking is "
+                             "unreliable here"))
+        if emitted is not None and priced is not None:
+            from flexflow_tpu.search.validate import diff_collectives
+            for problem in diff_collectives(priced, emitted):
+                if "priced none" in problem:
+                    diags.append(error(
+                        "FFL201", f"unpriced collective: {problem}",
+                        hint="GSPMD inserted data movement the search "
+                             "never costed — the predicted iteration "
+                             "time is an undercount"))
+                elif "emitted none" in problem:
+                    diags.append(warning(
+                        "FFL203", f"phantom priced collective: {problem}",
+                        hint="the simulator charges for movement XLA "
+                             "optimized away — predictions overcount"))
+                else:
+                    diags.append(warning(
+                        "FFL202", f"collective byte drift: {problem}",
+                        hint="priced and emitted payloads disagree "
+                             "beyond tolerance — recalibrate "
+                             "(scripts/calibrate.py)"))
+        elif emitted is not None:
+            # no simulator: the static inference is the only priced-side
+            # proxy; an emitted kind it cannot explain is still suspect
+            for kind, eb in emitted.items():
+                ib = sum(inferred.get(k, {}).get("bytes", 0.0)
+                         for k in _COVER.get(kind, {kind}))
+                if ib <= 0:
+                    diags.append(warning(
+                        "FFL205",
+                        f"emitted {kind} ({eb / 1e6:.2f} MB) matches no "
+                        f"statically-inferred collective",
+                        hint="run with the native simulator available "
+                             "for the authoritative priced diff"))
+        return diags

@@ -1,0 +1,184 @@
+"""dtype-policy: the bf16 master-weight regime's f32 islands.
+
+Under mixed precision the executor feeds every op bf16 working copies
+of the parameters and bf16 activations; the regime is only numerically
+safe because specific computations deliberately upcast: normalization
+statistics (a bf16 variance loses most of its mantissa), loss math, and
+metric accumulation. This pass verifies those islands statically by
+abstractly tracing each norm-family op with bf16 inputs/params and
+inspecting the jaxpr — no device work, no concrete arrays:
+
+* FFL401  a norm op (BatchNorm/GroupNorm/LayerNorm/RMSNorm) accumulates
+          a statistics reduction in a 16-bit dtype (a reduce-sum with a
+          16-bit output in its traced forward — ``jnp.mean``/``var``
+          upcast their accumulator automatically, so this only fires on
+          genuinely bf16-accumulated reductions: manual lax reductions
+          and explicit ``dtype=bfloat16`` sums);
+* FFL402  a norm's statistics VALUES are 16-bit where they are applied
+          or stored (new-state leaves non-f32) — the EMA accumulates
+          rounding step after step and the normalize subtracts a mean
+          that lost 2^-8 of relative precision;
+* FFL403  loss/metric accumulation poisoned at the graph level: an
+          explicit CAST to a 16-bit dtype feeds the designated model
+          output (the loss would compute on truncated logits) or a
+          large reduction (low-precision accumulation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic, error, warning
+from flexflow_tpu.ffconst import DataType, OperatorType
+
+_NORM_OPS = {OperatorType.BATCHNORM, OperatorType.GROUPNORM,
+             OperatorType.LAYERNORM, OperatorType.RMSNORM}
+_LOW_PRECISION = {DataType.HALF, DataType.BFLOAT16}
+_REDUCE_OPS = {OperatorType.REDUCE_SUM, OperatorType.MEAN}
+# reductions this small are epilogue math, not accumulation
+_MIN_REDUCED_ELEMS = 1024
+
+
+def _bf16_struct(shape):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.bfloat16)
+
+
+def _trace_norm(op):
+    """Abstractly trace the op's forward under the bf16 regime. Returns
+    (bad_reduce, new_state_dtypes) — bad_reduce is True when a
+    reduction in the traced computation accumulates in a 16-bit float
+    (a reduce-sum whose output aval is bf16/f16), new_state_dtypes maps
+    state keys to result dtypes for stateful ops (None otherwise)."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.eval_shape(op.init_params, jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda s: _bf16_struct(s.shape)
+        if jnp.issubdtype(s.dtype, jnp.floating) else s, params)
+    state = op.init_state() if hasattr(op, "init_state") else None
+    shp = op.input_shapes[0]
+    if getattr(op, "exec_layout", "NCHW") == "NHWC" and len(shp) == 4:
+        shp = tuple(shp[d] for d in (0, 2, 3, 1))
+    x = _bf16_struct(shp)
+
+    from flexflow_tpu.ops.base import OpContext
+
+    def run(p, s, xx):
+        ctx = OpContext(training=True, compute_dtype=jnp.bfloat16)
+        if s is not None:
+            outs = op.forward(p, [xx], ctx, state=s)
+        else:
+            outs = op.forward(p, [xx], ctx)
+        ns = getattr(op, "_new_state", None)
+        op._new_state = None  # never leak tracers into the executor
+        return outs, ns
+
+    try:
+        jaxpr = jax.make_jaxpr(run)(params, state, x)
+    finally:
+        op._new_state = None
+    bad_reduce = False
+    low = (jnp.bfloat16, jnp.float16)
+    # additive reductions only: max/min/and/or reductions are exact in
+    # any dtype, and jnp.mean/var/sum force an f32 accumulator for
+    # 16-bit inputs — so a 16-bit additive reduce here means raw
+    # lax.reduce/lax.reduce_sum accumulation, the genuinely lossy case
+    _exact = ("reduce_max", "reduce_min", "reduce_or", "reduce_and",
+              "reduce_precision", "reduce_window")
+    for eqn in jaxpr.jaxpr.eqns:
+        name = eqn.primitive.name
+        if not name.startswith("reduce") or name.startswith(_exact):
+            continue
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and dt in low:
+                bad_reduce = True
+    _, ns_shape = jax.eval_shape(run, params, state, x)
+    ns_dtypes = None
+    if ns_shape is not None:
+        ns_dtypes = {k: v.dtype for k, v in ns_shape.items()}
+    return bad_reduce, ns_dtypes
+
+
+class DtypePolicyPass:
+    name = "dtype-policy"
+
+    def run(self, ctx) -> List[Diagnostic]:
+        diags: List[Diagnostic] = []
+        seen: Dict = {}
+        for node in ctx.nodes:
+            op = node.op
+            if op.op_type in _NORM_OPS:
+                key = op.param_key()
+                if key in seen:
+                    verdict = seen[key]
+                else:
+                    try:
+                        verdict = _trace_norm(op)
+                    except Exception:
+                        verdict = None  # untraceable: covered by runtime
+                    seen[key] = verdict
+                if verdict is None:
+                    continue
+                bad_reduce, ns_dtypes = verdict
+                if bad_reduce:
+                    diags.append(error(
+                        "FFL401",
+                        f"{op.op_type.name} accumulates a statistics "
+                        f"reduction in a 16-bit dtype",
+                        op=op.name, guid=op.guid,
+                        hint="upcast before the mean/var reduction "
+                             "(x.astype(f32)); a bf16 accumulator loses "
+                             "most of its mantissa"))
+                import jax.numpy as jnp
+                for k, dt in (ns_dtypes or {}).items():
+                    if jnp.issubdtype(dt, jnp.floating) \
+                            and dt != jnp.float32:
+                        diags.append(error(
+                            "FFL402",
+                            f"running statistic {k!r} accumulates in "
+                            f"{jnp.dtype(dt).name}",
+                            op=op.name, guid=op.guid, tensor=k,
+                            hint="EMA state must stay f32 — per-step "
+                                 "rounding compounds over training"))
+            diags.extend(self._cast_audit(node, ctx))
+        return diags
+
+    # ---- FFL403 ------------------------------------------------------------
+    def _cast_audit(self, node, ctx) -> List[Diagnostic]:
+        op = node.op
+        if op.op_type != OperatorType.CAST \
+                or op.dtype not in _LOW_PRECISION:
+            return []
+        diags: List[Diagnostic] = []
+        if ctx.final_ref is not None and op.guid == ctx.final_ref[0]:
+            diags.append(error(
+                "FFL403",
+                f"designated model output is a cast to {op.dtype.value} "
+                f"— loss/metrics would compute on truncated logits",
+                op=op.name, guid=op.guid,
+                hint="the loss path upcasts internally but a 16-bit "
+                     "output has already lost the mantissa; drop the "
+                     "cast or move it off the loss path"))
+        for cnode, _ in ctx.consumers().get((op.guid, 0), []):
+            if cnode.op.op_type in _REDUCE_OPS:
+                axes = cnode.op.layer.get_property("axes", ())
+                shp = cnode.op.input_shapes[0]
+                reduced = int(np.prod(
+                    [shp[a % len(shp)] for a in axes])) if axes else 1
+                if reduced >= _MIN_REDUCED_ELEMS:
+                    diags.append(warning(
+                        "FFL403",
+                        f"{cnode.op.op_type.name} accumulates "
+                        f"{reduced} elements in {op.dtype.value}",
+                        op=cnode.op.name, guid=cnode.op.guid,
+                        hint="sum in f32 and cast after — bf16 "
+                             "accumulation plateaus once the running "
+                             "sum dwarfs the addend"))
+        return diags

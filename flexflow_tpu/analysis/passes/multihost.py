@@ -1,0 +1,85 @@
+"""multihost-order: the static deadlock detector.
+
+Multi-controller SPMD's cardinal rule: every process must issue the
+same collectives in the same order, or the fleet deadlocks with each
+host parked in a different all-reduce (the failure takes a wall-clock
+timeout to even notice on real pods). Per-host programs are identical
+by construction when every host runs the same compiled step — but the
+moment anything host-dependent leaks into compilation (host-conditional
+graph edits, per-host shape differences from a skewed dataloader, a
+rank-gated layer) the orders diverge.
+
+This pass takes the per-host optimized-HLO texts
+(``LintContext.hlo_per_host``, e.g. collected by the multihost dryrun)
+and compares the ordered collective sequences:
+
+* FFL501  two hosts disagree on the k-th collective (kind or shape) —
+          a guaranteed deadlock/corruption at step time;
+* FFL502  a host's program has a different collective COUNT (one host
+          will wait forever on a collective its peers never enter).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic, error
+from flexflow_tpu.obs.inspect import COLLECTIVE_KINDS
+
+_SEQ_RE = re.compile(
+    # "%name = SHAPE opcode(" — SHAPE is a typed array (with optional
+    # layout braces) or a tuple; requiring the "= SHAPE" prefix keeps
+    # LHS names like %all-reduce.58 from matching
+    r"\S+\s*=\s*((?:\w+\[[^\]]*\](?:\{[^}]*\})?|\([^)]*\)))\s*"
+    r"(" + "|".join(COLLECTIVE_KINDS) + r")(-start|-done)?[.\d]*\(")
+
+
+def collective_sequence(hlo_text: str) -> List[Tuple[str, str]]:
+    """Ordered (kind, shape) list of collectives in an HLO module, in
+    program order. Async -start/-done pairs count once (the -start is
+    where the host enters the rendezvous)."""
+    seq: List[Tuple[str, str]] = []
+    for line in hlo_text.splitlines():
+        m = _SEQ_RE.search(line)
+        if not m or m.group(3) == "-done":
+            continue
+        seq.append((m.group(2), m.group(1).strip()))
+    return seq
+
+
+class MultihostOrderPass:
+    name = "multihost-order"
+
+    def run(self, ctx) -> List[Diagnostic]:
+        texts = ctx.hlo_per_host
+        if not texts or len(texts) < 2:
+            from flexflow_tpu.analysis.orchestrator import SkipPass
+            raise SkipPass("needs >= 2 per-host HLO programs "
+                           "(hlo_per_host); single-program runs are "
+                           "order-consistent by construction")
+        diags: List[Diagnostic] = []
+        seqs = [collective_sequence(t) for t in texts]
+        ref = seqs[0]
+        for host, seq in enumerate(seqs[1:], start=1):
+            if len(seq) != len(ref):
+                diags.append(error(
+                    "FFL502",
+                    f"host {host} issues {len(seq)} collectives, host 0 "
+                    f"issues {len(ref)} — a host will block forever on "
+                    f"a rendezvous its peers never enter",
+                    hint="diff the per-host programs; something "
+                         "host-dependent leaked into compilation"))
+            for k, (a, b) in enumerate(zip(ref, seq)):
+                if a != b:
+                    diags.append(error(
+                        "FFL501",
+                        f"collective order diverges at position {k}: "
+                        f"host 0 runs {a[0]} {a[1]}, host {host} runs "
+                        f"{b[0]} {b[1]}",
+                        hint="mismatched collective sequences deadlock "
+                             "(or silently corrupt when kinds pair up "
+                             "wrong) — per-host programs must be "
+                             "identical"))
+                    break  # first divergence per host pair is enough
+        return diags

@@ -1,0 +1,209 @@
+"""sharding-legality: per-dim degrees and parallel-op compatibility.
+
+The PCG's core invariant (tensor.ParallelDim: size % degree == 0) is
+enforced dynamically at materialization for degree-form shapes, but a
+strategy arrives as PartitionSpecs whose degrees are implied by mesh-axis
+extents — nothing checked those until GSPMD failed (or worse, silently
+padded). This pass verifies, without compiling anything:
+
+* FFL101  a spec shards a dim whose extent the implied degree does not
+          divide (GSPMD pads — the simulator priced the unpadded tensor);
+* FFL102  a spec names a mesh axis the mesh does not carry;
+* FFL103  a parameter spec is illegal against the op's parameter shapes;
+* FFL104  a parallel op (repartition/combine/replicate/reduction) is
+          incompatible with its mesh axis or its producer's sharding;
+* FFL105  one spec uses the same mesh axis on two dims.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic, error, warning
+from flexflow_tpu.ffconst import OperatorType
+# parameter name -> shape via eval_shape: the strategy decoder's own
+# notion of which params an op owns, so lint and decode never disagree
+from flexflow_tpu.search.unity import _param_shapes
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def _spec_entries(spec, ndim: int) -> List:
+    entries = list(spec) if spec is not None else []
+    return (entries + [None] * ndim)[:ndim]
+
+
+def _check_spec(spec, shape, axis_sizes: Dict[str, int], op_name: str,
+                guid: int, what: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    if spec is None:
+        return diags
+    entries = _spec_entries(spec, len(shape))
+    if len(tuple(spec)) > len(shape):
+        diags.append(error(
+            "FFL103",
+            f"{what}: spec {tuple(spec)} has more entries than the "
+            f"rank-{len(shape)} tensor",
+            op=op_name, guid=guid, tensor=what,
+            hint="drop the extra entries; specs index tensor dims"))
+    used: Dict[str, int] = {}
+    for d, entry in enumerate(entries):
+        axes = _entry_axes(entry)
+        degree = 1
+        for ax in axes:
+            if ax not in axis_sizes:
+                diags.append(error(
+                    "FFL102",
+                    f"{what}: dim {d} sharded over mesh axis {ax!r} "
+                    f"but the mesh carries {sorted(axis_sizes)}",
+                    op=op_name, guid=guid, tensor=what,
+                    hint="axis dropped or renamed — re-export the "
+                         "strategy against this mesh"))
+                continue
+            degree *= axis_sizes[ax]
+            used[ax] = used.get(ax, 0) + 1
+        if degree > 1 and d < len(shape) and shape[d] % degree != 0:
+            diags.append(error(
+                "FFL101",
+                f"{what}: dim {d} extent {shape[d]} not divisible by "
+                f"sharding degree {degree} ({'+'.join(axes)})",
+                op=op_name, guid=guid, tensor=what,
+                hint="GSPMD will pad the shards; the simulator priced "
+                     "the unpadded tensor — pick a dividing degree"))
+    for ax, n in used.items():
+        if n > 1:
+            diags.append(error(
+                "FFL105",
+                f"{what}: mesh axis {ax!r} shards {n} dims of the same "
+                f"tensor",
+                op=op_name, guid=guid, tensor=what,
+                hint="an axis can shard at most one dim per tensor"))
+    return diags
+
+
+class ShardingLegalityPass:
+    name = "sharding-legality"
+
+    def run(self, ctx) -> List[Diagnostic]:
+        diags: List[Diagnostic] = []
+        axis_sizes = ctx.axis_sizes
+        if not axis_sizes:
+            from flexflow_tpu.analysis.orchestrator import SkipPass
+            raise SkipPass("no mesh in context")
+        for node in ctx.nodes:
+            op = node.op
+            # the applied (post-apply_strategy) specs on the node are the
+            # executor's truth; fall back to the raw strategy entry for
+            # contexts built from a strategy alone
+            specs = getattr(node, "output_specs", None)
+            st = ctx.strategy.get(op.guid)
+            if specs is None and st is not None:
+                specs = st.output_specs
+            for i, spec in enumerate(specs or []):
+                if i >= len(op.output_shapes):
+                    break
+                diags.extend(_check_spec(
+                    spec, op.output_shapes[i], axis_sizes, op.name,
+                    op.guid, f"output[{i}]"))
+            param_specs = getattr(node, "param_specs", None)
+            if not param_specs and st is not None:
+                param_specs = st.param_specs
+            if param_specs:
+                shapes = _param_shapes(op)
+                for pname, spec in param_specs.items():
+                    shp = shapes.get(pname)
+                    if shp is None:
+                        diags.append(warning(
+                            "FFL103",
+                            f"param spec for {pname!r} but the op owns no "
+                            f"such parameter",
+                            op=op.name, guid=op.guid, tensor=pname,
+                            hint="stale strategy file? parameter names "
+                                 "are the executor's param-tree keys"))
+                        continue
+                    diags.extend(_check_spec(
+                        spec, tuple(shp), axis_sizes, op.name, op.guid,
+                        f"param:{pname}"))
+            diags.extend(self._check_parallel_op(node, ctx, axis_sizes))
+        return diags
+
+    # ---- parallel-op in/out compatibility (FFL104) ------------------------
+    def _check_parallel_op(self, node, ctx, axis_sizes) -> List[Diagnostic]:
+        op = node.op
+        if not getattr(op, "is_parallel_op", False):
+            return []
+        diags: List[Diagnostic] = []
+        t = op.op_type
+        if t == OperatorType.REPARTITION:
+            ax = op.axis
+            if ax not in axis_sizes:
+                diags.append(error(
+                    "FFL104",
+                    f"repartition over mesh axis {ax!r} but the mesh "
+                    f"carries {sorted(axis_sizes)}",
+                    op=op.name, guid=op.guid,
+                    hint="pass repartition(axis=...) naming a real axis"))
+            elif op.repartition_degree != axis_sizes[ax]:
+                diags.append(error(
+                    "FFL104",
+                    f"repartition degree {op.repartition_degree} != mesh "
+                    f"axis {ax!r} extent {axis_sizes[ax]}",
+                    op=op.name, guid=op.guid,
+                    hint="under GSPMD the degree must equal the axis "
+                         "extent it maps to"))
+        elif t == OperatorType.COMBINE:
+            src = self._producer_spec(node, ctx)
+            if src is not None:
+                d = op.combine_dim % len(op.output_shapes[0])
+                entries = _spec_entries(src, len(op.output_shapes[0]))
+                if not _entry_axes(entries[d]):
+                    diags.append(warning(
+                        "FFL104",
+                        f"combine(dim={d}) of an input not sharded on "
+                        f"that dim — the op is a no-op",
+                        op=op.name, guid=op.guid,
+                        hint="dead resharding; drop the combine or fix "
+                             "the upstream repartition dim"))
+        elif t == OperatorType.REDUCTION:
+            shp = op.input_shapes[0]
+            d = op.reduction_dim % len(shp)
+            # degree-divides-extent is enforced at materialization; what
+            # is NOT is the degree matching an actual replica factor:
+            # reducing a dim the strategy never produced partial copies
+            # on silently averages real data
+            src = self._producer_spec(node, ctx)
+            if src is not None:
+                entries = _spec_entries(src, len(shp))
+                axes = _entry_axes(entries[d])
+                degree = math.prod(axis_sizes.get(a, 1) for a in axes)
+                if axes and degree != op.reduction_degree:
+                    diags.append(error(
+                        "FFL104",
+                        f"reduction(dim={d}, degree="
+                        f"{op.reduction_degree}) over a dim sharded "
+                        f"{degree}-way",
+                        op=op.name, guid=op.guid,
+                        hint="the reduction degree must equal the "
+                             "replica count laid out on that dim"))
+        return diags
+
+    @staticmethod
+    def _producer_spec(node, ctx):
+        ref = node.input_refs[0] if node.input_refs else None
+        if not ref or ref[0] != "op":
+            return None
+        prod = ctx.by_guid.get(ref[1])
+        if prod is None:
+            return None
+        specs = getattr(prod, "output_specs", None)
+        if specs is None:
+            st = ctx.strategy.get(ref[1])
+            specs = st.output_specs if st is not None else None
+        if not specs or ref[2] >= len(specs):
+            return None
+        return specs[ref[2]]

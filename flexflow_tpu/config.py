@@ -83,6 +83,11 @@ class FFConfig:
     # execution-time Conv+BN(+ReLU) folding for the inference/eval
     # executables (the reference's fused conv kernels, conv_2d_kernels.cu)
     fold_conv_bn: bool = True
+    # fflint static verification at compile time (flexflow_tpu/analysis):
+    # "off" skips it, "warn" prints the report, "error" additionally
+    # raises when any ERROR-severity diagnostic fires (illegal sharding
+    # degree, unpriced collective, dead-wrong dtype policy, ...)
+    lint: str = "off"
     # runtime observability (flexflow_tpu/obs): when set, fit/evaluate
     # write per-step Chrome-trace/JSONL artifacts, a compiled-step
     # summary (XLA cost/memory analysis + collective census), and a
@@ -204,6 +209,12 @@ class FFConfig:
                 self.conv_compute_layout = v
             elif a == "--disable-conv-bn-fold":
                 self.fold_conv_bn = False
+            elif a == "--lint":
+                v = take().lower()
+                if v not in ("off", "warn", "error"):
+                    raise ValueError(
+                        f"--lint expects off|warn|error, got {v!r}")
+                self.lint = v
             else:
                 rest.append(a)
             i += 1

@@ -492,13 +492,20 @@ class FFModel:
                 metrics: Sequence[MetricsType] = (),
                 comp_mode: CompMode = CompMode.TRAINING,
                 machine_spec: Optional[MachineSpec] = None,
-                mesh=None, outputs=None) -> None:
+                mesh=None, outputs=None,
+                lint: Optional[str] = None) -> None:
         """Materialize ops, choose a strategy, build jitted executables.
 
         Mirrors FFModel::compile (model.cc:2802): Layer->Op materialization,
         strategy search (or data-parallel default), then instead of Legion
         region allocation + NCCL bootstrap, mesh construction + sharding
         assignment + jit.
+
+        ``lint`` runs the fflint static verifier (flexflow_tpu/analysis)
+        over the materialized PCG + chosen strategy before parameters
+        are allocated: "warn" prints the report, "error" raises on any
+        ERROR-severity diagnostic. None defers to ``FFConfig.lint``
+        (the ``--lint`` flag); the report lands in ``self.lint_report``.
         """
         cfg = self.config
         cfg.computation_mode = comp_mode
@@ -731,6 +738,25 @@ class FFModel:
                 nodes, input_names, final_ref, self.mesh, loss_type,
                 self.metrics, self.optimizer, **exec_kwargs)
         self.executor.comp_mode = comp_mode
+        # --- fflint static verification (flexflow_tpu/analysis) ----------
+        # runs BEFORE parameter allocation so an illegal strategy fails
+        # fast instead of deep inside jit
+        self.lint_report = None
+        lint_mode = (lint if lint is not None
+                     else getattr(cfg, "lint", "off")) or "off"
+        if lint_mode not in ("off", "warn", "error"):
+            raise ValueError(
+                f"lint expects off|warn|error, got {lint_mode!r}")
+        if lint_mode != "off":
+            from flexflow_tpu.analysis import lint_model
+            self.lint_report = lint_model(self)
+            if self.lint_report.diagnostics:
+                print(self.lint_report.format_human())
+            if lint_mode == "error" and self.lint_report.has_errors():
+                raise ValueError(
+                    f"fflint: {len(self.lint_report.errors)} error-"
+                    f"severity diagnostic(s) — see report above "
+                    f"(compile with lint='warn' to proceed anyway)")
         self._rng, sub = jax.random.split(self._rng)
         self.params, self.state = self.executor.init_params_and_state(sub)
         # INFERENCE (ffconst.h:46 CompMode): forward-only executable — no

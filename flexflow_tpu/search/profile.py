@@ -354,10 +354,66 @@ def measure_runtime_constants() -> Dict[str, float]:
     return {"__step_overhead__": overhead, "__update_bw__": bw}
 
 
+def load_op_corrections(path: Optional[str] = None,
+                        platform: Optional[str] = None
+                        ) -> Dict[str, Dict[str, float]]:
+    """Drift-derived per-op-type correction factors from CALIBRATION.json
+    (written by ``scripts/calibrate.py --ingest-drift``). The file keys
+    them platform-first ({platform: {op type: {"factor": ..}}}); this
+    returns the bucket for ``platform`` (default: the current JAX
+    platform) — a CPU-derived correction must never scale TPU
+    measurements. Returns {} when no calibration exists.
+    ``FFS_CALIBRATION_FILE`` overrides the path (tests)."""
+    path = path or os.environ.get("FFS_CALIBRATION_FILE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "CALIBRATION.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    corr = data.get("op_corrections", {})
+    if not isinstance(corr, dict):
+        return {}
+    if platform is None:
+        platform = jax.devices()[0].platform
+    bucket = corr.get(platform, {})
+    return bucket if isinstance(bucket, dict) else {}
+
+
+def apply_drift_corrections(measured: Dict[str, float], nodes,
+                            corrections: Optional[Dict] = None
+                            ) -> Dict[str, float]:
+    """Scale each op's measured fwd/bwd seconds by its op type's
+    drift-correction factor — the write-back half of the recalibration
+    loop (observed runtime drift, ingested by ``calibrate.py
+    --ingest-drift``, flows into every future measured table the search
+    consumes). ``corrections`` defaults to the current platform's
+    bucket from CALIBRATION.json."""
+    if corrections is None:
+        corrections = load_op_corrections()
+    if not corrections:
+        return measured
+    out = dict(measured)
+    for node in nodes:
+        entry = corrections.get(node.op.op_type.name)
+        if not entry:
+            continue
+        factor = float(entry.get("factor", 1.0))
+        if factor <= 0:
+            continue
+        for leg in ("fwd", "bwd"):
+            key = f"{node.op.guid}:{leg}"
+            if key in out:
+                out[key] *= factor
+    return out
+
+
 def microbenchmark(nodes, repeats: int = 3, warmup: int = 1,
                    cache_file: Optional[str] = None,
                    hbm_bw: float = 0.82e12,
-                   verbose: bool = False) -> Dict[str, float]:
+                   verbose: bool = False,
+                   drift_corrections: bool = True) -> Dict[str, float]:
     """Measure every op in an OpNode list; returns the native search's
     measured table {"<guid>:fwd": s, "<guid>:bwd": s}.
 
@@ -365,6 +421,10 @@ def microbenchmark(nodes, repeats: int = 3, warmup: int = 1,
     are skipped — the search keeps its analytic estimate for those.
     ``cache_file`` persists measurements across processes, keyed by the
     op-config hash, so a re-run on an unchanged model costs nothing.
+    ``drift_corrections`` (default on; ``FFS_NO_DRIFT_CORRECTIONS=1``
+    disables) scales the table by the per-op-type factors ingested from
+    runtime drift reports — raw measurements stay in the cache, the
+    correction applies on the way out.
     """
     disk: Dict[str, List[float]] = {}
     if cache_file and os.path.exists(cache_file):
@@ -403,4 +463,6 @@ def microbenchmark(nodes, repeats: int = 3, warmup: int = 1,
                 json.dump({k: list(v) for k, v in _CACHE.items()}, f)
         except OSError:
             pass
+    if drift_corrections and not os.environ.get("FFS_NO_DRIFT_CORRECTIONS"):
+        measured = apply_drift_corrections(measured, nodes)
     return measured

@@ -177,7 +177,15 @@ def decode_strategy(resp: Dict[str, Any], nodes) -> Tuple[Dict[str, int], Strate
         for entries in oj["outputs"]:
             outs.append(_entries_to_spec([_entry(e) for e in entries]))
         params = {}
+        # the native side enumerates param specs from the op TYPE (e.g. a
+        # Linear always gets kernel+bias entries); filter against the
+        # parameters the materialized op actually owns, or a bias-less
+        # rewrite-fused Linear carries a phantom 'bias' spec forever
+        # (fflint FFL103)
+        owned = _param_shapes(node.op)
         for pname, entries in oj.get("params", {}).items():
+            if owned and pname not in owned:
+                continue
             params[pname] = _entries_to_spec([_entry(e) for e in entries])
         st = OpStrategy(output_specs=outs, param_specs=params)
         st.choice = oj.get("choice")

@@ -33,6 +33,21 @@ _HLO_KINDS = {
     "all-to-all": "reshard",
 }
 
+# which priced kinds cover an emitted (or statically-inferred) kind —
+# the ONE definition shared by diff_collectives and the fflint
+# collective-inference pass, so the two layers can never classify the
+# same collective differently. An emitted all-gather is covered by a
+# priced allreduce because XLA decomposes large ARs into reduce-scatter
+# + all-gather (observed on the dp_head psum at the residual add — the
+# RS half keeps the 'allreduce' bucket, the AG half lands here);
+# 'reshard' prices cover permute/all-to-all layout changes.
+COLLECTIVE_COVER = {
+    "allreduce": {"allreduce"},
+    "allgather": {"allgather", "reshard", "allreduce"},
+    "ppermute": {"ppermute", "reshard"},
+    "reshard": {"reshard", "allgather", "ppermute"},
+}
+
 
 def emitted_collectives(hlo_text: str, min_bytes: float = PRICED_MIN_BYTES
                         ) -> Dict[str, float]:
@@ -184,17 +199,7 @@ def diff_collectives(priced: Dict[str, float], emitted: Dict[str, float],
     ppermute/all-to-all match priced 'reshard' too.
     """
     problems = []
-    # An emitted all-gather is covered by a priced allreduce because XLA
-    # decomposes large ARs into reduce-scatter + all-gather (observed on
-    # the dp_head psum at the residual add — the RS half keeps the
-    # 'allreduce' bucket, the AG half lands here); byte totals still
-    # reconcile through tol_factor.
-    cover = {
-        "allreduce": {"allreduce"},
-        "allgather": {"allgather", "reshard", "allreduce"},
-        "ppermute": {"ppermute", "reshard"},
-        "reshard": {"reshard", "allgather", "ppermute"},
-    }
+    cover = COLLECTIVE_COVER
     for kind, eb in emitted.items():
         pb = sum(priced.get(k, 0.0) for k in cover.get(kind, {kind}))
         if pb <= 0:

@@ -173,6 +173,59 @@ def actual_step_time(ff, xs, y, repeats=3):
     return max(ts[len(ts) // 2], 1e-9)
 
 
+def derive_op_corrections(reports) -> dict:
+    """Per-op-type correction factors from drift reports — the
+    derivation half of the recalibration loop (ROADMAP item).
+
+    Each report carries the per-op predicted times (``per_op`` rows)
+    and the measured/predicted step ratio. The global residual
+    actual/predicted is attributed to op types weighted by each type's
+    share of the report's predicted compute: a type dominating the
+    prediction absorbs that report's drift, a type contributing 1%
+    barely moves. Across reports the factor is the share-weighted mean
+    — so a conv-heavy trace recalibrates CONV2D while a transformer
+    trace recalibrates LINEAR/ATTENTION, and both coexist.
+
+    The factors land in CALIBRATION.json ``op_corrections`` — keyed by
+    PLATFORM first, then op type, so drift observed on CPU can never
+    blend into or clobber a factor derived on the chip — and are
+    applied by ``search/profile.py apply_drift_corrections`` (which
+    reads only the current platform's bucket) to every measured table
+    the native search consumes (fflint's calibration pass warns when a
+    priced op type has no factor)."""
+    num: dict = {}  # (platform, type) -> share-weighted ratio sum
+    den: dict = {}
+    for rep in reports:
+        pred = rep.get("predicted") or {}
+        total = pred.get("total_s")
+        act = (rep.get("measured") or {}).get("step_s")
+        per_op = rep.get("per_op") or []
+        if not (total and act and per_op):
+            continue
+        ratio = float(act) / float(total)
+        compute = sum(float(r.get("sharded_s") or 0.0) for r in per_op)
+        if compute <= 0:
+            continue
+        platform = (rep.get("header") or {}).get("platform") or "unknown"
+        shares: dict = {}
+        for r in per_op:
+            t = r.get("type")
+            if t:
+                shares[t] = shares.get(t, 0.0) + \
+                    float(r.get("sharded_s") or 0.0) / compute
+        for t, share in shares.items():
+            num[(platform, t)] = num.get((platform, t), 0.0) + share * ratio
+            den[(platform, t)] = den.get((platform, t), 0.0) + share
+    out: dict = {}
+    for (platform, t) in sorted(num):
+        if den[(platform, t)] <= 0:
+            continue
+        out.setdefault(platform, {})[t] = dict(
+            factor=round(num[(platform, t)] / den[(platform, t)], 4),
+            weight=round(den[(platform, t)], 4))
+    return out
+
+
 def ingest_drift(trace_dir: str) -> int:
     """Fold ``*.drift.json`` obs artifacts into CALIBRATION.json.
 
@@ -184,6 +237,12 @@ def ingest_drift(trace_dir: str) -> int:
     replaces its previous rows in place, while reports from a different
     directory — e.g. another model whose fit also traced as "fit" —
     accumulate alongside instead of being clobbered.
+
+    Additionally derives per-op-type correction factors from the
+    reports' per-op predicted shares (``derive_op_corrections``) and
+    merges them into ``op_corrections`` — which
+    ``flexflow_tpu/search/profile.py`` applies to every measured table
+    it hands the native search, closing the recalibration loop.
     """
     import glob
 
@@ -200,6 +259,7 @@ def ingest_drift(trace_dir: str) -> int:
         print(f"no *.drift.json artifacts in {trace_dir}")
         return 1
     rows = []
+    reports = []
     for p in paths:
         try:
             with open(p) as f:
@@ -207,6 +267,7 @@ def ingest_drift(trace_dir: str) -> int:
         except (OSError, ValueError) as e:
             print(f"skip {p}: {e}")
             continue
+        reports.append(rep)
         header = rep.get("header", {})
         pred = (rep.get("predicted") or {}).get("total_s")
         act = (rep.get("measured") or {}).get("step_s")
@@ -236,9 +297,23 @@ def ingest_drift(trace_dir: str) -> int:
                       if not (r.get("source") == "drift_report"
                               and (r.get("trace_dir"),
                                    r.get("artifact")) in ingested)] + rows
+    corrections = derive_op_corrections(reports)
+    n_corr = 0
+    if corrections:
+        merged = cal.setdefault("op_corrections", {})
+        for platform, bucket in corrections.items():
+            # merge within the platform bucket only: a CPU-traced CI run
+            # must never clobber factors derived on the chip
+            merged.setdefault(platform, {}).update(bucket)
+            n_corr += len(bucket)
+            for t, e in bucket.items():
+                print(f"  correction [{platform}] {t:24s} "
+                      f"x{e['factor']:.4f} (weight {e['weight']:.3f})")
     with open(cal_path, "w") as f:
         json.dump(cal, f, indent=1)
-    print(f"ingested {len(rows)} drift report(s) into {cal_path}")
+    print(f"ingested {len(rows)} drift report(s) into {cal_path}"
+          + (f"; {n_corr} op-type correction(s) -> "
+             f"search/profile.py measured tables" if n_corr else ""))
     return 0
 
 

@@ -4,6 +4,16 @@
 # the identical gate. Keep this in lockstep with ROADMAP.md: if the
 # roadmap command changes, change it here in the same commit.
 #
+# After the pytest gate, a lint stage runs fflint (the static strategy
+# & graph verifier, flexflow_tpu/analysis) over the whole model zoo and
+# writes the JSON report to FFLINT.json next to the bench artifacts.
+# Lint ERRORs fail the gate only when the tests themselves passed, so a
+# test regression is never masked by a lint exit code.
+#
 # Usage: scripts/run_t1.sh      (run from anywhere; cd's to the repo root)
 cd "$(dirname "$0")/.." || exit 2
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c);
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then echo "FFLINT: exit $lint_rc (see FFLINT.json / /tmp/_t1_lint.err)"; else echo "FFLINT: clean (FFLINT.json)"; fi
+if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
+exit $rc

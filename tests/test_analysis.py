@@ -1,0 +1,524 @@
+"""fflint verifier tests (flexflow_tpu/analysis).
+
+Each pass proves it fires on a hand-seeded violation — illegal sharding
+degree, unpriced collective, mismatched host order, bf16 statistics,
+redundant transposes, dead ops — with the rule id and severity the
+README catalog promises, plus a clean-model no-diagnostics case and the
+compile-time wiring (``compile(lint="error")`` rejects an illegal
+imported strategy before any parameter is allocated).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                          SGDOptimizer, Severity, lint_model)
+from flexflow_tpu.analysis import LintContext, run_passes
+from flexflow_tpu.analysis.passes.calibration import CalibrationPass
+from flexflow_tpu.analysis.passes.collectives import (
+    CollectiveInferencePass, infer_strategy_collectives)
+from flexflow_tpu.analysis.passes.dtype import DtypePolicyPass
+from flexflow_tpu.analysis.passes.hygiene import GraphHygienePass
+from flexflow_tpu.analysis.passes.layout import LayoutConsistencyPass
+from flexflow_tpu.analysis.passes.multihost import (MultihostOrderPass,
+                                                    collective_sequence)
+from flexflow_tpu.analysis.passes.sharding import ShardingLegalityPass
+
+pytestmark = pytest.mark.analysis
+
+
+def small_mlp(batch=16, compile_kw=None, **cfg_kw):
+    from flexflow_tpu.models.mlp import create_mlp
+    ff = create_mlp(batch_size=batch, in_dim=64, hidden_dims=(128, 128),
+                    out_dim=10, ff_config=FFConfig(batch_size=batch,
+                                                   **cfg_kw))
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+               **(compile_kw or {}))
+    return ff
+
+
+def ctx_of(ff, **kw):
+    return LintContext(nodes=ff.executor.nodes, mesh=ff.mesh,
+                       strategy=ff.strategy, machine_spec=ff.machine_spec,
+                       config=ff.config, final_ref=ff.executor.final_ref,
+                       ff=ff, **kw)
+
+
+def rules(diags):
+    return {d.rule for d in diags}
+
+
+class TestCleanModel:
+    def test_no_diagnostics_on_clean_mlp(self):
+        rep = lint_model(small_mlp())
+        assert not rep.errors and not rep.warnings, rep.format_human()
+        # the static passes all ran; multihost/calibration record WHY not
+        assert rep.passes["sharding-legality"] == "ok"
+        assert rep.passes["graph-hygiene"] == "ok"
+        assert "skipped" in rep.passes["multihost-order"]
+        assert "skipped" in rep.passes["calibration"]
+
+    def test_report_json_shape(self):
+        rep = lint_model(small_mlp())
+        doc = rep.to_json()
+        assert set(doc) == {"context", "passes", "counts", "diagnostics"}
+        assert doc["counts"] == dict(error=0, warning=0, info=0)
+        json.dumps(doc)  # serializable
+
+
+class TestShardingLegality:
+    def test_illegal_degree_fires_ffl101(self):
+        ff = small_mlp()
+        # head output dim is 10: sharding it 8-way cannot divide
+        head = ff.executor.nodes[-2]
+        head.output_specs[0] = P(None, "data")
+        diags = run_passes(ctx_of(ff), [ShardingLegalityPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL101"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "not divisible" in hits[0].message
+
+    def test_unknown_axis_fires_ffl102(self):
+        ff = small_mlp()
+        ff.executor.nodes[0].output_specs[0] = P("bogus")
+        diags = run_passes(ctx_of(ff), [ShardingLegalityPass()]).diagnostics
+        assert any(d.rule == "FFL102" and d.severity == Severity.ERROR
+                   for d in diags)
+
+    def test_duplicate_axis_fires_ffl105(self):
+        ff = small_mlp()
+        ff.executor.nodes[0].output_specs[0] = P("data", "data")
+        diags = run_passes(ctx_of(ff), [ShardingLegalityPass()]).diagnostics
+        assert any(d.rule == "FFL105" and d.severity == Severity.ERROR
+                   for d in diags)
+
+    def test_repartition_axis_mismatch_fires_ffl104(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 64))
+        t = ff.repartition(t, dim=1, degree=4, axis="model")  # no model axis
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        diags = run_passes(ctx_of(ff), [ShardingLegalityPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL104"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "repartition" in hits[0].message
+
+
+class TestCollectiveInference:
+    def test_dp_grad_sync_is_inferred(self):
+        ff = small_mlp()
+        inferred = infer_strategy_collectives(ctx_of(ff))
+        assert "allreduce" in inferred
+        assert any(s.endswith(":grad")
+                   for s in inferred["allreduce"]["sources"])
+
+    def test_unpriced_inferred_collective_fires_ffl204(self):
+        ff = small_mlp()
+        # the simulator (injected) priced NOTHING for a data-parallel
+        # strategy whose grad sync provably exists
+        ctx = ctx_of(ff, priced={})
+        diags = run_passes(ctx, [CollectiveInferencePass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL204"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "priced none" in hits[0].message
+
+    def test_unpriced_emitted_collective_fires_ffl201(self):
+        ff = small_mlp()
+        ctx = ctx_of(ff,
+                     priced={"allreduce": 1e6},
+                     emitted={"allreduce": 1e6, "ppermute": 5e6})
+        diags = run_passes(ctx, [CollectiveInferencePass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL201"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "ppermute" in hits[0].message
+
+    def test_phantom_priced_collective_fires_ffl203(self):
+        ff = small_mlp()
+        ctx = ctx_of(ff, priced={"allreduce": 1e6, "ppermute": 8e6},
+                     emitted={"allreduce": 1e6})
+        diags = run_passes(ctx, [CollectiveInferencePass()]).diagnostics
+        assert any(d.rule == "FFL203"
+                   and d.severity == Severity.WARNING for d in diags)
+
+    def test_replicated_strategy_infers_no_grad_sync(self):
+        ff = small_mlp()
+        for node in ff.executor.nodes:
+            node.output_specs = [None] * len(node.output_specs)
+        ff.strategy = {}
+        inferred = infer_strategy_collectives(ctx_of(ff))
+        assert "allreduce" not in inferred
+
+
+class TestLayoutConsistency:
+    def test_redundant_transpose_pair_fires_ffl301(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 16, 32))
+        t = ff.transpose(t, (0, 2, 1))
+        t = ff.transpose(t, (0, 2, 1))  # composes to identity
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        diags = run_passes(ctx_of(ff),
+                           [LayoutConsistencyPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL301"]
+        assert hits and hits[0].severity == Severity.WARNING
+        assert "identity" in hits[0].message
+
+    def test_nhwc_on_rank2_fires_ffl303(self):
+        ff = small_mlp()
+        ff.executor.nodes[0].output_layouts = ["NHWC"]
+        diags = run_passes(ctx_of(ff),
+                           [LayoutConsistencyPass()]).diagnostics
+        assert any(d.rule == "FFL303" and d.severity == Severity.ERROR
+                   for d in diags)
+
+    def test_broken_nhwc_chain_fires_ffl302(self):
+        ff = FFModel(FFConfig(batch_size=8, conv_compute_layout="nhwc"))
+        t = ff.create_tensor((8, 3, 16, 16))
+        t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1)
+        t = ff.relu(t)
+        t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1)
+        t = ff.flat(t)
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        # break the chain: force the relu (an NHWC pass-through op)
+        # back to NCHW between the two NHWC convs
+        relu_node = next(n for n in ff.executor.nodes
+                         if n.op.op_type.name == "RELU")
+        relu_node.input_layouts = ["NCHW"]
+        relu_node.output_layouts = ["NCHW"]
+        diags = run_passes(ctx_of(ff),
+                           [LayoutConsistencyPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL302"]
+        assert hits and hits[0].severity == Severity.WARNING
+        assert "NHWC chain" in hits[0].message
+
+
+class TestDtypePolicy:
+    def test_bf16_statistics_fire_ffl401_and_402(self):
+        import jax
+        import jax.numpy as jnp
+
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 4, 8, 8))
+        t = ff.batch_norm(t, relu=False)
+        t = ff.flat(t)
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        bn = next(n.op for n in ff.executor.nodes
+                  if n.op.op_type.name == "BATCHNORM")
+
+        def bad_forward(params, inputs, ctx, state=None):
+            # the seeded violation: statistics accumulated AND applied
+            # in the input dtype (a bf16-accumulated sum, bf16 mean/var)
+            (x,) = inputs
+            n = x.shape[0] * x.shape[2] * x.shape[3]
+            zero = jnp.zeros((), x.dtype)
+            mean = jax.lax.reduce(x, zero, jax.lax.add, (0, 2, 3)) / n
+            var = jax.lax.reduce(
+                (x - mean[None, :, None, None]) ** 2, zero, jax.lax.add,
+                (0, 2, 3)) / n
+            bn._new_state = {"mean": mean, "var": var}
+            y = (x - mean[None, :, None, None]) * jax.lax.rsqrt(
+                var[None, :, None, None] + 1e-5)
+            return [y]
+
+        bn.forward = bad_forward
+        diags = run_passes(ctx_of(ff), [DtypePolicyPass()]).diagnostics
+        assert any(d.rule == "FFL401" and d.severity == Severity.ERROR
+                   for d in diags), diags
+        assert any(d.rule == "FFL402" and d.severity == Severity.ERROR
+                   for d in diags), diags
+
+    def test_good_batchnorm_is_clean(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 4, 8, 8))
+        t = ff.batch_norm(t, relu=False)
+        t = ff.flat(t)
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        diags = run_passes(ctx_of(ff), [DtypePolicyPass()]).diagnostics
+        assert not diags, diags
+
+    def test_low_precision_output_cast_fires_ffl403(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 64))
+        t = ff.dense(t, 10)
+        t = ff.cast(t, DataType.BFLOAT16)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        diags = run_passes(ctx_of(ff), [DtypePolicyPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL403"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "truncated logits" in hits[0].message
+
+
+HLO_A = """
+ENTRY %main {
+  %ar = f32[1024,8]{1,0} all-reduce(f32[1024,8]{1,0} %p0)
+  %ag = f32[2048]{0} all-gather(f32[256]{0} %p1)
+}
+"""
+HLO_B = """
+ENTRY %main {
+  %ag = f32[2048]{0} all-gather(f32[256]{0} %p1)
+  %ar = f32[1024,8]{1,0} all-reduce(f32[1024,8]{1,0} %p0)
+}
+"""
+HLO_C = """
+ENTRY %main {
+  %ar = f32[1024,8]{1,0} all-reduce(f32[1024,8]{1,0} %p0)
+}
+"""
+
+
+class TestMultihostOrder:
+    def _ctx(self, texts):
+        ff = small_mlp()
+        return ctx_of(ff, hlo_per_host=texts)
+
+    def test_sequence_extraction(self):
+        seq = collective_sequence(HLO_A)
+        assert [k for k, _ in seq] == ["all-reduce", "all-gather"]
+
+    def test_matching_hosts_clean(self):
+        rep = run_passes(self._ctx([HLO_A, HLO_A]), [MultihostOrderPass()])
+        assert not rep.diagnostics
+        assert rep.passes["multihost-order"] == "ok"
+
+    def test_order_divergence_fires_ffl501(self):
+        rep = run_passes(self._ctx([HLO_A, HLO_B]), [MultihostOrderPass()])
+        hits = [d for d in rep.diagnostics if d.rule == "FFL501"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "position 0" in hits[0].message
+
+    def test_count_mismatch_fires_ffl502(self):
+        rep = run_passes(self._ctx([HLO_A, HLO_C]), [MultihostOrderPass()])
+        assert any(d.rule == "FFL502" and d.severity == Severity.ERROR
+                   for d in rep.diagnostics)
+
+    def test_single_program_skips(self):
+        rep = run_passes(self._ctx(None), [MultihostOrderPass()])
+        assert "skipped" in rep.passes["multihost-order"]
+
+
+class TestGraphHygiene:
+    def test_dead_op_fires_ffl601(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 64))
+        head = ff.dense(t, 10, name="head")
+        ff.dense(t, 32, name="dead_branch")  # output never consumed
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                   outputs=head)
+        diags = run_passes(ctx_of(ff), [GraphHygienePass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL601"]
+        assert hits and hits[0].severity == Severity.WARNING
+        assert hits[0].op == "dead_branch"
+        assert "parameters" in hits[0].message  # it owns weights
+
+    def test_unused_input_fires_ffl602(self):
+        ff = FFModel(FFConfig(batch_size=8))
+        t = ff.create_tensor((8, 64), name="used")
+        ff.create_tensor((8, 32), name="unused")
+        t = ff.dense(t, 10)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+        diags = run_passes(ctx_of(ff), [GraphHygienePass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL602"]
+        assert hits and hits[0].tensor == "unused"
+
+    def test_shape_contradiction_fires_ffl603(self):
+        ff = small_mlp()
+        ff.executor.nodes[1].op.input_shapes[0] = (16, 999)
+        diags = run_passes(ctx_of(ff), [GraphHygienePass()]).diagnostics
+        assert any(d.rule == "FFL603" and d.severity == Severity.ERROR
+                   for d in diags)
+
+    def test_duplicate_name_fires_ffl604(self):
+        ff = small_mlp()
+        ff.executor.nodes[1].op.name = ff.executor.nodes[0].op.name
+        diags = run_passes(ctx_of(ff), [GraphHygienePass()]).diagnostics
+        assert any(d.rule == "FFL604" and d.severity == Severity.ERROR
+                   for d in diags)
+
+
+class TestCalibrationPass:
+    def _searched_ctx(self, ff):
+        ctx = ctx_of(ff)
+        ctx.searched = True
+        return ctx
+
+    def test_no_calibration_fires_ffl701(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FFS_CALIBRATION_FILE",
+                           str(tmp_path / "nonexistent.json"))
+        ff = small_mlp()
+        diags = run_passes(self._searched_ctx(ff),
+                           [CalibrationPass()]).diagnostics
+        assert any(d.rule == "FFL701" and d.severity == Severity.WARNING
+                   for d in diags)
+
+    def test_partial_corrections_fire_ffl702(self, tmp_path, monkeypatch):
+        import jax
+        platform = jax.devices()[0].platform
+        cal = dict(platform=platform, op_corrections={
+            platform: {"LINEAR": dict(factor=1.2, weight=1.0)}})
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps(cal))
+        monkeypatch.setenv("FFS_CALIBRATION_FILE", str(p))
+        ff = small_mlp()  # graph also has SOFTMAX (flops > 0), uncorrected
+        diags = run_passes(self._searched_ctx(ff),
+                           [CalibrationPass()]).diagnostics
+        hits = [d for d in diags if d.rule == "FFL702"]
+        assert hits and "SOFTMAX" in hits[0].message
+
+    def test_stale_platform_fires_ffl703(self, tmp_path, monkeypatch):
+        cal = dict(platform="tpu", op_corrections={
+            "tpu": {"LINEAR": dict(factor=1.2, weight=1.0),
+                    "SOFTMAX": dict(factor=1.1, weight=1.0)}})
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps(cal))
+        monkeypatch.setenv("FFS_CALIBRATION_FILE", str(p))
+        ff = small_mlp()  # running on cpu: tpu-only corrections = stale
+        diags = run_passes(self._searched_ctx(ff),
+                           [CalibrationPass()]).diagnostics
+        assert any(d.rule == "FFL703" for d in diags)
+
+    def test_heuristic_strategy_skips(self):
+        ff = small_mlp()
+        rep = run_passes(ctx_of(ff), [CalibrationPass()])
+        assert "skipped" in rep.passes["calibration"]
+
+
+class TestDriftCorrections:
+    """The recalibration loop: drift reports -> per-op factors ->
+    measured tables (scripts/calibrate.py + search/profile.py)."""
+
+    def _calibrate_module(self):
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "calibrate", os.path.join(repo, "scripts", "calibrate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_derive_op_corrections_weights_by_share(self):
+        mod = self._calibrate_module()
+        rep = dict(
+            header=dict(platform="cpu"),
+            predicted=dict(total_s=0.01),
+            measured=dict(step_s=0.02),  # 2x drift
+            per_op=[dict(type="LINEAR", sharded_s=0.008),
+                    dict(type="SOFTMAX", sharded_s=0.002)])
+        corr = mod.derive_op_corrections([rep])
+        assert corr["cpu"]["LINEAR"]["factor"] == pytest.approx(2.0)
+        assert corr["cpu"]["LINEAR"]["weight"] == pytest.approx(0.8)
+        assert corr["cpu"]["SOFTMAX"]["weight"] == pytest.approx(0.2)
+
+    def test_derive_buckets_platforms_separately(self):
+        # a CPU-traced report must never blend into (or clobber) a
+        # factor derived on the chip — buckets are per platform
+        mod = self._calibrate_module()
+        cpu = dict(header=dict(platform="cpu"),
+                   predicted=dict(total_s=0.01),
+                   measured=dict(step_s=0.04),  # 4x drift on CPU
+                   per_op=[dict(type="LINEAR", sharded_s=0.01)])
+        tpu = dict(header=dict(platform="tpu"),
+                   predicted=dict(total_s=0.01),
+                   measured=dict(step_s=0.011),  # 1.1x on the chip
+                   per_op=[dict(type="LINEAR", sharded_s=0.01)])
+        corr = mod.derive_op_corrections([cpu, tpu])
+        assert corr["cpu"]["LINEAR"]["factor"] == pytest.approx(4.0)
+        assert corr["tpu"]["LINEAR"]["factor"] == pytest.approx(1.1)
+
+    def test_corrections_scale_measured_tables(self, tmp_path, monkeypatch):
+        import jax
+        platform = jax.devices()[0].platform
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps(dict(op_corrections={
+            platform: {"LINEAR": dict(factor=3.0, weight=1.0)}})))
+        monkeypatch.setenv("FFS_CALIBRATION_FILE", str(p))
+        from flexflow_tpu.search.profile import apply_drift_corrections
+        ff = small_mlp()
+        nodes = ff.executor.nodes
+        guid = next(n.op.guid for n in nodes
+                    if n.op.op_type.name == "LINEAR")
+        measured = {f"{guid}:fwd": 1e-5, f"{guid}:bwd": 2e-5}
+        out = apply_drift_corrections(measured, nodes)
+        assert out[f"{guid}:fwd"] == pytest.approx(3e-5)
+        assert out[f"{guid}:bwd"] == pytest.approx(6e-5)
+        # another platform's bucket never applies here
+        p.write_text(json.dumps(dict(op_corrections={
+            "not-" + platform: {"LINEAR": dict(factor=3.0, weight=1.0)}})))
+        out2 = apply_drift_corrections(measured, nodes)
+        assert out2[f"{guid}:fwd"] == pytest.approx(1e-5)
+
+
+class TestCompileWiring:
+    def test_lint_error_rejects_illegal_imported_strategy(self, tmp_path):
+        # a strategy file sharding a batch-6 model 8-way: legal to
+        # import (the axis exists), illegal to run (6 % 8 != 0) — lint
+        # catches it at compile, before any parameter is allocated
+        strat = dict(version=1, mesh=dict(data=8), ops={
+            "mlp_0": dict(choice=None, outputs=[["data"]], params={})})
+        sf = tmp_path / "strategy.json"
+        sf.write_text(json.dumps(strat))
+        from flexflow_tpu.models.mlp import create_mlp
+        cfg = FFConfig(batch_size=6)
+        cfg.import_strategy_file = str(sf)
+        ff = create_mlp(batch_size=6, in_dim=64, hidden_dims=(128,),
+                        out_dim=10, ff_config=cfg)
+        with pytest.raises(ValueError, match="fflint"):
+            ff.compile(SGDOptimizer(lr=0.01),
+                       LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                       lint="error")
+
+    def test_lint_warn_records_report(self):
+        ff = small_mlp(compile_kw=dict(lint="warn"))
+        assert ff.lint_report is not None
+        assert not ff.lint_report.has_errors()
+
+    def test_lint_off_by_default(self):
+        ff = small_mlp()
+        assert ff.lint_report is None
+
+    def test_config_flag_parses(self):
+        cfg = FFConfig()
+        rest = cfg.parse_args(["--lint", "error", "--epochs", "2"])
+        assert cfg.lint == "error" and cfg.epochs == 2 and not rest
+        with pytest.raises(ValueError):
+            FFConfig().parse_args(["--lint", "nonsense"])
+
+
+class TestOrchestrator:
+    def test_crashing_pass_reports_ffl000(self):
+        class Boom:
+            name = "boom"
+
+            def run(self, ctx):
+                raise RuntimeError("kaboom")
+
+        ff = small_mlp()
+        rep = run_passes(ctx_of(ff), [Boom()])
+        assert "crashed" in rep.passes["boom"]
+        assert any(d.rule == "FFL000" for d in rep.diagnostics)
+
+    def test_errors_sort_before_warnings_in_json(self):
+        ff = small_mlp()
+        ff.executor.nodes[0].output_specs[0] = P("bogus")
+        ff.create_tensor((8, 3), name="unused_x")  # not in executor: no-op
+        rep = run_passes(ctx_of(ff), [ShardingLegalityPass(),
+                                      GraphHygienePass()])
+        doc = rep.to_json()
+        sevs = [d["severity"] for d in doc["diagnostics"]]
+        assert sevs == sorted(sevs, key=["error", "warning",
+                                         "info"].index)

@@ -90,6 +90,57 @@ class TestCandleUno:
                  metrics=(MetricsType.MEAN_SQUARED_ERROR,))
 
 
+def _fflint_cli():
+    """The fflint CLI module — its ZOO list is the single source of
+    truth for 'every zoo model', so a model added there is
+    automatically swept here too."""
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fflint_cli", os.path.join(repo, "scripts", "fflint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCensusInvariant:
+    """ROADMAP "collective census as a search invariant", closed: for
+    EVERY zoo model, the searched strategy's statically-inferred
+    collective set must be covered by the set the native simulator
+    priced (fflint collective-inference pass, FFL204/FFL201 are
+    ERROR-severity). A model whose searched strategy implies data
+    movement the search never costed fails CI here, not on the chip."""
+
+    @pytest.mark.analysis
+    @pytest.mark.parametrize("name", _fflint_cli().ZOO)
+    def test_searched_strategy_collectives_are_priced(self, name):
+        from flexflow_tpu.search.native import available
+        if not available():
+            pytest.skip("native search unavailable")
+        from flexflow_tpu.analysis import LintContext, run_passes
+        from flexflow_tpu.analysis.passes.collectives import (
+            CollectiveInferencePass)
+
+        cli = _fflint_cli()
+        cfg = FFConfig()
+        cfg.search_budget = 4
+        cfg.enable_parameter_parallel = True
+        cfg.enable_pipeline_parallel = False
+        ff, loss_kind = cli.build_model(name, cfg)
+        cli.compile_model(ff, loss_kind)
+        ctx = LintContext(
+            nodes=ff.executor.nodes, mesh=ff.mesh, strategy=ff.strategy,
+            machine_spec=ff.machine_spec, config=ff.config,
+            final_ref=ff.executor.final_ref, ff=ff)
+        rep = run_passes(ctx, [CollectiveInferencePass()])
+        assert rep.passes["collective-inference"] == "ok", rep.passes
+        errors = rep.errors
+        assert not errors, (
+            f"{name}: searched strategy carries unpriced collectives:\n"
+            + "\n".join(d.format() for d in errors))
+
+
 class TestMoE:
     def test_flat_moe_trains_and_balances(self):
         cfg = MoEConfig(batch_size=16, input_dim=32, num_exp=4, num_select=2,
