@@ -255,16 +255,11 @@ def ratchet(hist, key, samples_per_s, config, protocol):
         (old if old != protocol else None)
 
 
-def census_ratchet(hist, key, total_bytes, tol=0.01):
-    """Collective BYTE-VOLUME ratchet per workload family (ROADMAP
-    trace-regression gate): lower is better, and unlike samples/s the
-    census is a property of the compiled program — chip weather cannot
-    hide a strategy regression that adds comms. Records the best (lowest)
-    per-device collective bytes per step under ``collective_bytes`` in
-    the same history entry the throughput ratchet uses; returns
-    (regression: bool, baseline_bytes). A new low updates the baseline;
-    anything more than ``tol`` above it is a regression the caller must
-    surface loudly."""
+def _low_water_ratchet(hist, key, field, value, tol):
+    """Shared downward ratchet for compile-determined metrics (census
+    bytes, HBM peak): lower is better; a new low updates ``field`` in
+    the workload's history entry, anything more than ``tol`` above the
+    recorded best is a regression. Returns (regression, baseline)."""
     entry = hist.get(key)
     if not isinstance(entry, dict):
         # legacy bare-number entry: preserve it as the samples/s baseline
@@ -272,12 +267,22 @@ def census_ratchet(hist, key, total_bytes, tol=0.01):
         entry = ({"samples_per_s": float(entry)}
                  if isinstance(entry, (int, float)) else {})
         hist[key] = entry
-    baseline = entry.get("collective_bytes")
-    regression = (baseline is not None
-                  and total_bytes > baseline * (1.0 + tol))
-    if baseline is None or total_bytes < baseline:
-        entry["collective_bytes"] = float(total_bytes)
+    baseline = entry.get(field)
+    regression = baseline is not None and value > baseline * (1.0 + tol)
+    if baseline is None or value < baseline:
+        entry[field] = float(value)
     return regression, baseline
+
+
+def census_ratchet(hist, key, total_bytes, tol=0.01):
+    """Collective BYTE-VOLUME ratchet per workload family (ROADMAP
+    trace-regression gate): unlike samples/s the census is a property of
+    the compiled program — chip weather cannot hide a strategy
+    regression that adds comms. Best (lowest) per-device bytes per step
+    live under ``collective_bytes`` in the same history entry the
+    throughput ratchet uses."""
+    return _low_water_ratchet(hist, key, "collective_bytes", total_bytes,
+                              tol)
 
 
 def emit_obs_artifacts(name, ff, tracer):
@@ -304,12 +309,13 @@ def emit_obs_artifacts(name, ff, tracer):
         return None
 
 
-def census_bytes_for(name, ff, summary):
-    """Per-device collective bytes the compiled step moves (the obs
-    census total). Reuses a summary already computed for --trace-dir;
-    otherwise pays one AOT lower+compile of the train step.
-    FFS_SKIP_CENSUS=1 opts out (e.g. a time-boxed tunnel run). Returns
-    None when unavailable — the ratchet then simply doesn't engage."""
+def step_summary_for(name, ff, summary):
+    """The compiled-step summary (collective census + XLA memory
+    analysis), computed at most once per workload. Reuses a summary
+    already computed for --trace-dir; otherwise pays one AOT
+    lower+compile of the train step. FFS_SKIP_CENSUS=1 opts out (e.g. a
+    time-boxed tunnel run). Returns None when unavailable — the byte and
+    HBM ratchets then simply don't engage."""
     if summary is None and not os.environ.get("FFS_SKIP_CENSUS"):
         try:
             from flexflow_tpu.obs import inspect_model_step
@@ -318,11 +324,33 @@ def census_bytes_for(name, ff, summary):
             print(f"[obs] {name}: census inspection failed: {e!r}",
                   file=sys.stderr)
             return None
-    if summary is None:
-        return None
-    total = summary.get("collectives_total") or {}
+    return summary
+
+
+def census_bytes_of(summary):
+    """Per-device collective bytes the compiled step moves (census
+    total), or None."""
+    total = (summary or {}).get("collectives_total") or {}
     b = total.get("bytes")
     return float(b) if b is not None else None
+
+
+def hbm_peak_of(summary):
+    """Per-device HBM peak the compiled step needs (XLA compiled memory
+    analysis: live arguments + temp), or None."""
+    mem = (summary or {}).get("memory") or {}
+    b = mem.get("peak_bytes")
+    return float(b) if b else None
+
+
+def hbm_ratchet(hist, key, peak_bytes, tol=0.02):
+    """HBM-peak ratchet per workload family, the memory sibling of
+    ``census_ratchet``: XLA's compiled memory analysis is also a
+    property of the program, so a regression that bloats optimizer
+    state or loses buffer donation fails the bench even when chip
+    weather hides the samples/s cost. Best peak lives under
+    ``hbm_peak_bytes``."""
+    return _low_water_ratchet(hist, key, "hbm_peak_bytes", peak_bytes, tol)
 
 
 def main():
@@ -345,6 +373,7 @@ def main():
     workloads_out = {}
     protocol_notes = []
     census_regressions = []
+    memory_regressions = []
     for name, build, iters in WORKLOADS:
         iters = 5 if on_cpu else iters
         windows = 1 if on_cpu else 3
@@ -361,7 +390,9 @@ def main():
             summary = None
             if tracer is not None and tracer.active:
                 summary = emit_obs_artifacts(name, ff, tracer)
-            cbytes = census_bytes_for(name, ff, summary)
+            summary = step_summary_for(name, ff, summary)
+            cbytes = census_bytes_of(summary)
+            hbm_peak = hbm_peak_of(summary)
         except Exception as e:
             if name == "bert_proxy":
                 raise  # the headline metric must never be silently absent
@@ -384,6 +415,16 @@ def main():
                 census_regressions.append(
                     f"{name}: {cbytes:.0f} B/step vs recorded best "
                     f"{byte_base:.0f}")
+        if hbm_peak is not None:
+            # memory sibling of the census gate: per-device HBM peak from
+            # XLA's compiled memory analysis (the metric weight-update
+            # sharding moves) ratchets alongside throughput
+            mreg, peak_base = hbm_ratchet(hist, key, hbm_peak)
+            wl["hbm_peak_bytes"] = round(hbm_peak, 1)
+            if mreg:
+                memory_regressions.append(
+                    f"{name}: {hbm_peak:.0f} B peak vs recorded best "
+                    f"{peak_base:.0f}")
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",
@@ -408,6 +449,8 @@ def main():
     result["workloads"] = workloads_out
     if census_regressions:
         result["census_regressions"] = census_regressions
+    if memory_regressions:
+        result["memory_regressions"] = memory_regressions
     if protocol_notes:
         result["protocol_change"] = ("vs_baseline spans protocols — " +
                                      "; ".join(protocol_notes))

@@ -95,6 +95,16 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
     data_deg = 1
     for ax in ("data", "replica"):
         data_deg *= axis_sizes.get(ax, 1)
+    # weight-update sharding: the executor's runtime flag is the truth
+    # (searched strategies additionally mark per-op "_wus" choices)
+    executor = ctx.ff.executor if ctx.ff is not None else None
+    wus_on = bool(executor is not None
+                  and getattr(executor, "weight_update_sharding", False))
+    # leaves the executor ACTUALLY shards (per-param divisibility): the
+    # gather payload is their element count, not the op's full nelem —
+    # non-divisible leaves keep a plain all-reduce with no gather
+    wus_specs = (executor.wus_param_specs()
+                 if wus_on and hasattr(executor, "wus_param_specs") else {})
 
     for node in ctx.nodes:
         op = node.op
@@ -113,11 +123,31 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
         if training and data_deg > 1 and nelem > 0 and data_sharded:
             # gradient sync: a batch-sharded op's replicated params see
             # different rows per device, so their grads all-reduce over
-            # the data axes (params sharded over 'data' would
-            # reduce-scatter instead — same priced bucket). A fully
-            # replicated op ("rep" choice) computes identical grads on
-            # every device and needs no sync.
-            add("allreduce", nelem * elem, f"{op.name}:grad")
+            # the data axes. A fully replicated op ("rep" choice)
+            # computes identical grads on every device and needs no sync.
+            st_choice = getattr(ctx.strategy.get(op.guid), "choice",
+                                None) or ""
+            if wus_on or "_wus" in st_choice:
+                # weight-update sharding: the sync is a reduce-scatter
+                # (XLA's AR-decomposition half — stays in the allreduce
+                # bucket) plus the all-gather rebuilding the next step's
+                # compute params from the updated shards. Only the
+                # leaves the executor shards gather; hand-built contexts
+                # without an executor conservatively gather everything.
+                sharded = nelem
+                if executor is not None:
+                    from flexflow_tpu.search.unity import _param_shapes
+                    leaf_specs = wus_specs.get(op.name, {})
+                    sharded = float(sum(
+                        int(np.prod(shp))
+                        for pname, shp in _param_shapes(op).items()
+                        if pname in leaf_specs))
+                add("allreduce", nelem * elem, f"{op.name}:grad-rs")
+                if sharded > 0:
+                    add("allgather", sharded * elem,
+                        f"{op.name}:wus-gather")
+            else:
+                add("allreduce", nelem * elem, f"{op.name}:grad")
         # row-parallel contractions produce partial sums -> psum: a
         # contraction-dim-sharded kernel (Linear in-dim, attention
         # head-dim on wo, embedding vocab-dim)

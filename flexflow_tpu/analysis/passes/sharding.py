@@ -13,6 +13,11 @@ padded). This pass verifies, without compiling anything:
 * FFL104  a parallel op (repartition/combine/replicate/reduction) is
           incompatible with its mesh axis or its producer's sharding;
 * FFL105  one spec uses the same mesh axis on two dims.
+
+Under weight-update sharding the pass additionally verifies the
+executor's sharded master/optimizer-state specs (``wus:<param>``
+tensors) with the same FFL101/102/105 rules — an illegal WUS shard
+would otherwise only surface as GSPMD padding deep inside jit.
 """
 
 from __future__ import annotations
@@ -130,6 +135,33 @@ class ShardingLegalityPass:
                         spec, tuple(shp), axis_sizes, op.name, op.guid,
                         f"param:{pname}"))
             diags.extend(self._check_parallel_op(node, ctx, axis_sizes))
+        diags.extend(self._check_wus_specs(ctx, axis_sizes))
+        return diags
+
+    # ---- weight-update-sharding state specs -------------------------------
+    @staticmethod
+    def _check_wus_specs(ctx, axis_sizes) -> List[Diagnostic]:
+        """Verify the data-sharded master-param/optimizer-state layout
+        the executor derived for weight-update sharding (the specs the
+        f32 master, Adam moments, and the reduce-scattered gradients
+        actually live on)."""
+        ex = getattr(ctx.ff, "executor", None) if ctx.ff is not None else None
+        if ex is None or not getattr(ex, "weight_update_sharding", False):
+            return []
+        diags: List[Diagnostic] = []
+        by_name = {n.op.name: n for n in ctx.nodes}
+        for op_name, specs in ex.wus_param_specs().items():
+            node = by_name.get(op_name)
+            if node is None:
+                continue
+            shapes = _param_shapes(node.op)
+            for pname, spec in specs.items():
+                shp = shapes.get(pname)
+                if shp is None:
+                    continue
+                diags.extend(_check_spec(
+                    spec, tuple(shp), axis_sizes, op_name, node.op.guid,
+                    f"wus:{pname}"))
         return diags
 
     # ---- parallel-op in/out compatibility (FFL104) ------------------------

@@ -83,6 +83,15 @@ class FFConfig:
     # execution-time Conv+BN(+ReLU) folding for the inference/eval
     # executables (the reference's fused conv kernels, conv_2d_kernels.cu)
     fold_conv_bn: bool = True
+    # weight-update sharding (WUS / ZeRO-style optimizer sharding): the
+    # data-axis gradient sync becomes a reduce-scatter, the f32 master
+    # params + optimizer moments live sharded over the data axis, and the
+    # next step's bf16 compute params are all-gathered inside the same
+    # optimizer fusion. 'auto' follows the search's per-mesh verdict when
+    # a searched strategy exists (the native DP prices WUS vs all-reduce
+    # per choice) and otherwise engages at data degree >= 4; 'on'/'off'
+    # force it. Training-only; the pipeline executor keeps plain sync.
+    weight_update_sharding: str = "auto"
     # fflint static verification at compile time (flexflow_tpu/analysis):
     # "off" skips it, "warn" prints the report, "error" additionally
     # raises when any ERROR-severity diagnostic fires (illegal sharding
@@ -209,6 +218,13 @@ class FFConfig:
                 self.conv_compute_layout = v
             elif a == "--disable-conv-bn-fold":
                 self.fold_conv_bn = False
+            elif a == "--weight-update-sharding":
+                v = take().lower()
+                if v not in ("auto", "on", "off"):
+                    raise ValueError(
+                        f"--weight-update-sharding expects auto|on|off, "
+                        f"got {v!r}")
+                self.weight_update_sharding = v
             elif a == "--lint":
                 v = take().lower()
                 if v not in ("off", "warn", "error"):

@@ -67,6 +67,7 @@ class GraphExecutor:
         data_axes: Tuple[str, ...] = ("data",),
         final_is_softmax: bool = False,
         fold_conv_bn: bool = True,
+        weight_update_sharding: bool = False,
     ):
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
@@ -93,9 +94,109 @@ class GraphExecutor:
         # flat-buffer layout — is the lever.
         self.use_master_copy = compute_dtype != jnp.float32
         self.fold_conv_bn = fold_conv_bn
+        # weight-update sharding (WUS): the data-axis gradient sync runs
+        # as a reduce-scatter onto a per-param shard spec, the f32 master
+        # copy + optimizer moments live sharded over the data axes, and
+        # the next step's bf16 compute params are all-gathered inside the
+        # same optimizer fusion (preserving the one-extra-bf16-write
+        # property). Per-chip optimizer HBM then scales with params/chip
+        # instead of total params. Only meaningful with a data degree > 1.
+        self.weight_update_sharding = bool(
+            weight_update_sharding and self._data_degree() > 1)
+        self._by_name = {n.op.name: n for n in nodes}
         self._jit_train = None
         self._jit_eval = None
         self._jit_fwd = {}  # keyed by training flag
+
+    # ---- weight-update sharding (WUS) -------------------------------------
+    def _data_degree(self) -> int:
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        deg = 1
+        for a in self.data_axes:
+            deg *= sizes.get(a, 1)
+        return deg
+
+    def _wus_axis_entry(self):
+        da = tuple(self.data_axes)
+        return da[0] if len(da) == 1 else da
+
+    def wus_spec(self, op_name: str, pname: str,
+                 shape: Tuple[int, ...]) -> Optional[P]:
+        """Data-sharded spec for a master-param/optimizer-state leaf, or
+        None when the leaf stays replicated (WUS off, scalar, or no free
+        dim the data degree divides). Composes with the strategy's param
+        spec: the data axes land on the first unsharded dividing dim, so
+        a model-sharded kernel shards 2-D (model x data)."""
+        if not self.weight_update_sharding:
+            return None
+        node = self._by_name.get(op_name)
+        if node is None:
+            return None
+        base = node.param_specs.get(pname, P())
+        entries = (list(base) + [None] * len(shape))[:len(shape)]
+        deg = self._data_degree()
+        for d, e in enumerate(entries):
+            if e is None and shape[d] > 0 and shape[d] % deg == 0:
+                entries[d] = self._wus_axis_entry()
+                return P(*entries)
+        return None
+
+    def wus_param_specs(self) -> Dict[str, Dict[str, P]]:
+        """{op name: {param name: sharded spec}} of every leaf WUS
+        actually shards — the sharded-state truth fflint's sharding pass
+        verifies against the mesh."""
+        if not self.weight_update_sharding:
+            return {}
+        from flexflow_tpu.search.unity import _param_shapes
+        out: Dict[str, Dict[str, P]] = {}
+        for node in self.nodes:
+            for pname, shp in _param_shapes(node.op).items():
+                spec = self.wus_spec(node.op.name, pname, tuple(shp))
+                if spec is not None:
+                    out.setdefault(node.op.name, {})[pname] = spec
+        return out
+
+    def _wus_shard(self, tree):
+        """Constrain every float leaf of a params-shaped (sub)tree onto
+        its WUS spec. Applied to the gradients inside the train step,
+        this turns the data-axis gradient psum GSPMD would emit as an
+        all-reduce into a reduce-scatter (each chip keeps only its shard
+        of the summed gradient); applied to the updated params/moments it
+        pins the shard layout through the optimizer fusion."""
+        if not self.weight_update_sharding:
+            return tree
+
+        def leaf(path, x):
+            if len(path) < 2 or not hasattr(x, "shape"):
+                return x
+            spec = self.wus_spec(getattr(path[-2], "key", None),
+                                 getattr(path[-1], "key", None), x.shape)
+            if spec is None:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    def _constrain_compute(self, tree):
+        """Constrain a params-shaped tree onto the strategy (compute)
+        specs — the all-gather over the data axes that rebuilds the next
+        step's replicated bf16 working copy from the WUS shards, fused
+        into the optimizer update."""
+        if not self.weight_update_sharding:
+            return tree
+
+        def leaf(path, x):
+            if len(path) < 2 or not hasattr(x, "shape"):
+                return x
+            node = self._by_name.get(getattr(path[-2], "key", None))
+            if node is None:
+                return x
+            spec = node.param_specs.get(getattr(path[-1], "key", None), P())
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
 
     # ---- parameter / state initialization ---------------------------------
     def init_params_and_state(self, rng) -> Tuple[Dict, Dict]:
@@ -112,7 +213,8 @@ class GraphExecutor:
             return p
 
         params = jax.jit(_init)(rng)
-        params = jax.device_put(params, self.param_shardings(params))
+        params = jax.device_put(params, self.param_shardings(params,
+                                                            master=True))
         for node in self.nodes:
             if hasattr(node.op, "init_state"):
                 state[node.op.name] = node.op.init_state()
@@ -122,23 +224,36 @@ class GraphExecutor:
 
     def cast_compute_copy(self, params):
         """bf16 copy of the float parameter leaves (the forward/backward
-        working set under the master-weight regime)."""
+        working set under the master-weight regime). Under WUS the master
+        leaves are data-sharded, so the copy is all-gathered back onto the
+        compute (strategy) shardings here."""
         if not hasattr(self, "_cast_jit"):
             # cached: repeated refreshes (per-weight import loops) must not
             # retrace a fresh jit each call
             self._cast_jit = jax.jit(
                 lambda p: jax.tree.map(self._cast_leaf, p))
-        return self._cast_jit(params)
+        out = self._cast_jit(params)
+        if self.weight_update_sharding:
+            out = jax.device_put(out, self.param_shardings(out))
+        return out
 
     def _cast_leaf(self, x):
         if jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(self.compute_dtype)
         return x
 
-    def param_shardings(self, params):
+    def param_shardings(self, params, master: bool = False):
+        """NamedShardings tree for a params-shaped tree: the compute
+        (strategy) shardings, or — ``master=True`` under WUS — the
+        data-sharded master layout the optimizer state follows (zeros_like
+        inherits it, so sharded params get sharded m/v for free)."""
         def spec_for(op_name, pname, arr):
-            node = next(n for n in self.nodes if n.op.name == op_name)
+            node = self._by_name[op_name]
             spec = node.param_specs.get(pname, P())
+            if master:
+                w = self.wus_spec(op_name, pname, tuple(arr.shape))
+                if w is not None:
+                    spec = w
             return NamedSharding(self.mesh, spec)
 
         return {
@@ -289,16 +404,23 @@ class GraphExecutor:
             (loss, (logits, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(cparams)
-            # gradient allreduce over data axes is inserted by GSPMD here
-            # (in bf16 under the master-weight regime — half the bytes)
+            # gradient sync over the data axes is inserted by GSPMD here
+            # (in bf16 under the master-weight regime — half the bytes).
+            # Under WUS the shard constraint turns that all-reduce into a
+            # reduce-scatter: each chip receives only the gradient shard
+            # whose master-param/moment shard it owns.
+            grads = self._wus_shard(grads)
             new_params, new_opt_state = self.optimizer.update(
                 grads, opt_state, params
             )
+            new_params = self._wus_shard(new_params)
             if self.use_master_copy:
                 # next step's bf16 working copy, fused into the update loop
-                # (one extra bf16 write instead of a separate cast pass)
-                new_state[COMPUTE_PARAMS_KEY] = jax.tree.map(
-                    self._cast_leaf, new_params)
+                # (one extra bf16 write instead of a separate cast pass;
+                # under WUS the compute-spec constraint is the all-gather
+                # that rebuilds the replicated copy from the shards)
+                new_state[COMPUTE_PARAMS_KEY] = self._constrain_compute(
+                    jax.tree.map(self._cast_leaf, new_params))
             metric_vals = self.metrics.compute(logits, labels)
             return new_params, new_opt_state, new_state, loss, metric_vals
 

@@ -694,9 +694,35 @@ class FFModel:
         )
         data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
         axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        # weight-update sharding (WUS): reduce-scatter gradient sync +
+        # data-sharded master params / optimizer moments + fused all-gather
+        # of the next step's compute params (flexflow_tpu/executor.py).
+        # 'auto' defers to the native DP's per-mesh verdict when the
+        # strategy was searched (WUS is a priced choice dimension — the
+        # '_wus' choice suffix); heuristic strategies engage it at data
+        # degree >= 4, where the optimizer-state HBM win dominates.
+        import math as _math2
+        data_deg = _math2.prod(axes_now.get(a, 1) for a in data_axes) or 1
+        wus_mode = getattr(cfg, "weight_update_sharding", "auto")
+        if wus_mode not in ("auto", "on", "off"):
+            raise ValueError(f"weight_update_sharding expects auto|on|off, "
+                             f"got {wus_mode!r}")
+        searched = isinstance(self.search_info, dict)
+        searched_wus = searched and any(
+            "_wus" in (getattr(st, "choice", None) or "")
+            for st in (self.strategy or {}).values())
+        if (comp_mode == CompMode.INFERENCE or axes_now.get("pipe", 1) > 1
+                or wus_mode == "off"):
+            wus = False
+        elif wus_mode == "on":
+            wus = data_deg > 1
+        else:
+            wus = searched_wus if searched else data_deg >= 4
+        self.wus_enabled = wus
         exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
                            final_is_softmax=self._final_is_softmax,
-                           fold_conv_bn=cfg.fold_conv_bn)
+                           fold_conv_bn=cfg.fold_conv_bn,
+                           weight_update_sharding=wus)
         # conv-family execution layout (flexflow_tpu/layout.py): NCHW stays
         # the API/PCG boundary, but on TPU the conv family computes
         # channels-last with boundary transposes hoisted to chain edges.
@@ -1196,7 +1222,8 @@ class FFModel:
                            compute_dtype=full.compute_dtype,
                            data_axes=full.data_axes,
                            final_is_softmax=self._final_is_softmax,
-                           fold_conv_bn=full.fold_conv_bn)
+                           fold_conv_bn=full.fold_conv_bn,
+                           weight_update_sharding=full.weight_update_sharding)
         ex.comp_mode = full.comp_mode
         self._seq_execs[bucket] = ex
         return ex

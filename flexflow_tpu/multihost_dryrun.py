@@ -145,10 +145,15 @@ def worker_main(process_id: int, num_processes: int, port: int,
                 devices_per_proc: int, out_path: str) -> None:
     """One rendezvous participant (subprocess entry point)."""
     os.environ.pop("JAX_PLATFORMS", None)
+    # per-process virtual device count via XLA_FLAGS: must land in the
+    # environment BEFORE jax initializes its backend (the jax_num_cpu_devices
+    # config knob is unsupported by the pinned JAX — ROADMAP item)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", devices_per_proc)
 
     from flexflow_tpu import distributed
 
@@ -163,6 +168,16 @@ def worker_main(process_id: int, num_processes: int, port: int,
     # worker's fit writes *_hostNN artifacts the parent merges by host id
     trace_dir = os.environ.get("FFS_TRACE_DIR") or None
     ff, lx, ly = _build_and_train(total, trace_dir=trace_dir)
+    if trace_dir:
+        # per-host optimized-HLO dump for the fflint multihost-order pass
+        # (FFL501/502 static deadlock detector): every process writes the
+        # text of ITS compiled train step; the parent feeds the set
+        # through lint_model(ff, hlo_per_host=[...]) after the run
+        from flexflow_tpu.search.validate import train_step_hlo
+        hlo_path = os.path.join(trace_dir,
+                                f"train_step_host{process_id}.hlo.txt")
+        with open(hlo_path, "w") as f:
+            f.write(train_step_hlo(ff))
     out = {"loss": np.float64(ff._last_loss)}
     out.update({f"dp/{k}": v for k, v in _params_to_numpy(ff).items()})
     # evaluate + predict on the multi-host path: evaluate consumes local
@@ -198,6 +213,35 @@ def worker_main(process_id: int, num_processes: int, port: int,
                     f"{float(np.max(np.abs(got - want)))}")
         out["ckpt_roundtrip_ok"] = np.float64(1.0)
     np.savez(out_path, **out)
+
+
+def _lint_per_host_hlo(trace_dir: str, num_processes: int, ff) -> None:
+    """Feed the workers' per-host optimized-HLO dumps through fflint's
+    multihost-order pass (FFL501/502 static deadlock detector). Raises
+    when the per-host collective sequences diverge — the failure class
+    that on a real pod only shows as a rendezvous timeout."""
+    texts = []
+    for p in range(num_processes):
+        path = os.path.join(trace_dir, f"train_step_host{p}.hlo.txt")
+        if not os.path.exists(path):
+            raise AssertionError(
+                f"multihost dryrun: worker {p} did not dump its train-step "
+                f"HLO ({path}) — per-host collection is broken")
+        with open(path) as f:
+            texts.append(f.read())
+    from flexflow_tpu.analysis import lint_model
+    rep = lint_model(ff, hlo_per_host=texts)
+    order = [d for d in rep.diagnostics if d.rule in ("FFL501", "FFL502")]
+    if order:
+        raise AssertionError(
+            "multihost dryrun: per-host collective sequences diverge:\n"
+            + "\n".join(d.format() for d in order))
+    status = rep.passes.get("multihost-order")
+    if status != "ok":
+        raise AssertionError(
+            f"multihost dryrun: multihost-order pass did not run: {status}")
+    print(f"multihost dryrun: fflint multihost-order pass ok over "
+          f"{len(texts)} per-host HLO programs")
 
 
 def _free_port() -> int:
@@ -276,12 +320,21 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
     legs = ["dp"] + (["tp", "ring"] if _multi_axis_legs_possible(total) else [])
     refs = {}
     dp_extra = {}
+    dp_model = None
     for leg in legs:
         ref, rx, ry = _build_and_train(total, leg=leg)
         if leg == "dp":
+            dp_model = ref
             dp_extra["eval_loss"] = float(ref.evaluate(rx, ry)["loss"])
             dp_extra["predict"] = ref.predict(rx)
         refs[leg] = (_params_to_numpy(ref), float(ref._last_loss))
+
+    if trace_dir:
+        # the fflint FFL501/502 static deadlock pass, end-to-end: compare
+        # the per-host optimized-HLO collective sequences every worker
+        # dumped. A host-dependent divergence here is the bug class that
+        # otherwise only shows as a wall-clock timeout on a real pod.
+        _lint_per_host_hlo(trace_dir, num_processes, dp_model)
 
     loss_keys = {"dp": "loss", "tp": "tp_loss", "ring": "ring_loss"}
     for p, got in enumerate(worker_results):

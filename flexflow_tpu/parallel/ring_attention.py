@@ -181,5 +181,6 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                           causal=causal)
     # axes not named in the specs replicate, which is the intended layout
     # for dp x sp attention
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from flexflow_tpu.utils.shard_map_compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -131,9 +131,11 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
             # analog of a substitution rewrite changing the op's task
             # implementation); "head" choices record the head-sharded axis
             # so ring attention keeps heads distributed under shard_map
+            # ("_wus" may trail any choice name — weight-update sharding
+            # composes with every base choice, so match by substring)
             choice = getattr(st, "choice", None) or ""
             if hasattr(node.op, "seq_parallel"):
-                if choice.endswith("_ring") and axis_sizes.get("seq", 1) > 1:
+                if "_ring" in choice and axis_sizes.get("seq", 1) > 1:
                     node.op.seq_parallel = "seq"
                 if "head" in choice and axis_sizes.get("model", 1) > 1:
                     node.op.head_parallel = "model"
@@ -146,7 +148,7 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
                     entries = list(spec0)
                     node.op.batch_parallel = entries[0] if entries else None
             if (hasattr(node.op, "expert_parallel")
-                    and choice.endswith("_ep")
+                    and "_ep" in choice
                     and axis_sizes.get("expert", 1) > 1):
                 node.op.expert_parallel = "expert"
         op = node.op

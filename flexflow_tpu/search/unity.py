@@ -295,6 +295,11 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # --disable-fusion: gate the fuse_parallel_ops rewrite family
             # (kernel fusion itself belongs to XLA)
             perform_fusion=getattr(config, "perform_fusion", True),
+            # weight-update sharding as a searched dimension: "auto"/"on"
+            # enumerate the reduce-scatter+all-gather "_wus" choice twins
+            # (ffs_strategy.hpp); "off" removes them
+            weight_update_sharding=getattr(config, "weight_update_sharding",
+                                           "auto"),
         ),
         measured=measured or {},
     )

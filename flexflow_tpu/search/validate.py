@@ -129,12 +129,23 @@ def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
     from flexflow_tpu.search.unity import machine_to_json, serialize_graph
 
     nodes = ff.executor.nodes
+    wus_on = bool(getattr(ff.executor, "weight_update_sharding", False))
     assignment = {}
     for node in nodes:
         st = (ff.strategy or {}).get(node.op.guid)
         choice = getattr(st, "choice", None)
         if choice is None:
             choice = _infer_choice(node, st)
+        # replay what the executor EXECUTES, not what the DP picked:
+        # WUS applies globally at runtime (per-param by divisibility), so
+        # a searched strategy that mixed _wus and plain choices — or a
+        # forced --weight-update-sharding on/off — must replay uniformly
+        # or the priced-vs-emitted diff flags a correct model. The native
+        # side falls back to the base choice when an op spawns no twin.
+        if wus_on and "_wus" not in choice and node.op.params_elems():
+            choice += "_wus"
+        elif not wus_on and "_wus" in choice:
+            choice = choice.replace("_wus", "")
         assignment[str(node.op.guid)] = choice
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
     if axes.get("pipe", 1) > 1:

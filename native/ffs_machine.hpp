@@ -236,6 +236,38 @@ struct MachineModel {
     return t;
   }
 
+  // Weight-update-sharding gradient sync, reduce-scatter half: RS over the
+  // in-slice ring on ICI; cross-slice, each chip's 1/k_inner shard
+  // all-reduces over DCN (the hier_allreduce decomposition, with the
+  // all-gather half split out because WUS gathers UPDATED params later).
+  double wus_rs_time(double bytes, int k, int slices, int8_t axis = -1) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    slices = std::max(1, std::min(slices, num_slices));
+    int k_inner = std::max(1, k / slices);
+    double t = reducescatter_time(bytes, k_inner, axis);
+    if (slices > 1) {
+      double shard = bytes * comm_bytes_factor / k_inner;
+      t += dcn_latency * (slices - 1) +
+           2.0 * (slices - 1) / slices * shard / dcn_bw;
+    }
+    return t;
+  }
+
+  // All-gather half of the WUS sync: rebuild the replicated compute params
+  // from the per-chip shards after the local optimizer step.
+  double wus_ag_time(double bytes, int k, int slices, int8_t axis = -1) const {
+    if (k <= 1 || bytes <= 0) return 0.0;
+    slices = std::max(1, std::min(slices, num_slices));
+    int k_inner = std::max(1, k / slices);
+    double t = allgather_time(bytes, k_inner, axis);
+    if (slices > 1) {
+      double shard = bytes * comm_bytes_factor / k_inner;
+      t += dcn_latency * (slices - 1) +
+           (double)(slices - 1) / slices * shard / dcn_bw;
+    }
+    return t;
+  }
+
   // Fraction of a padded tile a dimension actually fills: the MXU is a
   // 128x128 systolic array, so a dim that is not a multiple of the tile
   // edge pads up and wastes the remainder (a 160-wide matmul runs two

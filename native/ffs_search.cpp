@@ -62,6 +62,8 @@ struct SearchConfig {
   int subst_budget = 0;             // best-first expansions (0 = from budget)
   bool perform_fusion = true;       // fuse_parallel_ops rule family
                                     // (reference --disable-fusion)
+  bool enable_wus = true;           // weight-update-sharding choice variants
+                                    // (--weight-update-sharding != off)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
   static SearchConfig from_json(const Json& j) {
@@ -86,6 +88,9 @@ struct SearchConfig {
     c.subst_budget = (int)j.get("subst_budget").as_int(
         std::max(1, std::min(4 * c.budget, 256)));
     c.perform_fusion = j.get("perform_fusion").as_bool(true);
+    // "auto"/"on" enumerate the _wus twins (the DP picks per mesh);
+    // "off" removes the dimension entirely
+    c.enable_wus = j.get("weight_update_sharding").as_string() != "off";
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
       for (const Json& a : r.get("allow").items()) names.push_back(a.as_string());
@@ -114,7 +119,11 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                 cfg.enable_parameter_parallel &&
                                     !cfg.only_data_parallel,
                                 cfg.enable_sample_parallel &&
-                                    !cfg.only_data_parallel);
+                                    !cfg.only_data_parallel,
+                                // no WUS twins on pipe meshes: the GPipe
+                                // lowering keeps plain gradient sync
+                                cfg.enable_wus && cfg.training &&
+                                    mesh.pp <= 1);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -244,7 +253,8 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
                                (double)g.nodes[pi].output_bytes(n.inputs[slot].src_idx),
                                mesh, m);
         }
-        NodeCost nc = node_cost(n, c, mesh, m, cfg.training, measured);
+        NodeCost nc = node_cost(n, c, mesh, m, cfg.training, measured,
+                                cfg.opt_state_factor);
         cost += nc.total();
         double pmem = node_param_memory(n, c, mesh, cfg.opt_state_factor);
         double amem = node_act_bytes(n, c, mesh);
@@ -828,9 +838,23 @@ Json simulate_only(const Json& req) {
   const Json& sel = req.get("assignment");
   for (size_t i = 0; i < g.nodes.size(); ++i) {
     std::string want = sel.get(std::to_string(g.nodes[i].guid)).as_string();
-    const Choice* pick = nullptr;
-    for (const Choice& c : choices[i])
-      if (c.name == want) { pick = &c; break; }
+    auto find = [&](const std::string& name) -> const Choice* {
+      for (const Choice& c : choices[i])
+        if (c.name == name) return &c;
+      return nullptr;
+    };
+    const Choice* pick = find(want);
+    if (pick == nullptr) {
+      // WUS-suffix fallback both ways: a heuristic replay may ask for a
+      // "_wus" twin an op doesn't spawn (no gradsync), and a stale
+      // strategy file may lack the suffix a wus-enabled run expects
+      const std::string sfx = "_wus";
+      if (want.size() > sfx.size() &&
+          want.compare(want.size() - sfx.size(), sfx.size(), sfx) == 0)
+        pick = find(want.substr(0, want.size() - sfx.size()));
+      else
+        pick = find(want + sfx);
+    }
     if (pick == nullptr)
       throw std::runtime_error("unknown/illegal choice '" + want +
                                "' for op " + std::to_string(g.nodes[i].guid));

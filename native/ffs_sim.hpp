@@ -131,6 +131,14 @@ class TaskgraphSimulator {
         fwd_id[i] = add(std::move(ct));
         res.comm_time += t;
       }
+      if (c.wgather_bytes > 0 && c.psum_k > 1) {
+        // tiny-batch row lowering: the kernel all-gathers once forward
+        double t = m_.allgather_time(c.wgather_bytes, c.psum_k, c.psum_axis);
+        SimTask ct{SimTask::Kind::Comm, (int)i, t, {fwd_id[i]},
+                   "allgather", c.wgather_bytes};
+        fwd_id[i] = add(std::move(ct));
+        res.comm_time += t;
+      }
       res.memory += node_param_memory(n, c, mesh_, opt_state_factor_);
       if (training_) {
         res.memory += node_act_bytes(n, c, mesh_);
@@ -175,6 +183,12 @@ class TaskgraphSimulator {
           dur += m_.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
           bwd_comm_bytes += c.psum_bytes;
         }
+        if (c.psum_k > 1 && c.bwd_psum_bytes > 0) {
+          // backward-only partial-sum AR (col-parallel dX, replicated
+          // scatter grads, tiny-batch weight-grad movement)
+          dur += m_.allreduce_time(c.bwd_psum_bytes, c.psum_k, c.psum_axis);
+          bwd_comm_bytes += c.bwd_psum_bytes;
+        }
         if (c.ring_bytes > 0 && c.ring_k > 1)  // bwd rotates K/V and dK/dV
           dur += 2.0 * m_.ring_time(c.ring_bytes, c.ring_k, kSeq);
         SimTask bt{SimTask::Kind::Bwd, i, dur, deps,
@@ -195,14 +209,32 @@ class TaskgraphSimulator {
         size_t i = N - 1 - j;
         const Choice& c = assign[i];
         if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {
-          double t = m_.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                            spans, kData);
           std::vector<int> deps = {bwd_id[i]};
           if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
-          SimTask st{SimTask::Kind::GradSync, (int)i, t, deps,
-                     "allreduce", c.gradsync_bytes};
-          sync_ids.push_back(add(std::move(st)));
-          res.gradsync_time += t;
+          if (c.wus) {
+            // WUS: reduce-scatter the gradients (the RS half keeps the
+            // census 'allreduce' bucket — XLA's AR decomposition), then
+            // all-gather the updated compute params. Priced as two
+            // tasks so the collective census diff sees both kinds.
+            double t1 = m_.wus_rs_time(c.gradsync_bytes, c.gradsync_k,
+                                       spans, kData);
+            SimTask rs{SimTask::Kind::GradSync, (int)i, t1, deps,
+                       "allreduce", c.gradsync_bytes};
+            int rs_id = add(std::move(rs));
+            double t2 = m_.wus_ag_time(c.gradsync_bytes, c.gradsync_k,
+                                       spans, kData);
+            SimTask ag{SimTask::Kind::GradSync, (int)i, t2, {rs_id},
+                       "allgather", c.gradsync_bytes};
+            sync_ids.push_back(add(std::move(ag)));
+            res.gradsync_time += t1 + t2;
+          } else {
+            double t = m_.hier_allreduce_time(c.gradsync_bytes,
+                                              c.gradsync_k, spans, kData);
+            SimTask st{SimTask::Kind::GradSync, (int)i, t, deps,
+                       "allreduce", c.gradsync_bytes};
+            sync_ids.push_back(add(std::move(st)));
+            res.gradsync_time += t;
+          }
         }
       }
       // optimizer update traffic: read p + read g + write p (3x params)
@@ -217,9 +249,15 @@ class TaskgraphSimulator {
         if (it != measured_->end() && it->second > 0) upd_bw = it->second;
       }
       double upd_bytes = 0;
-      for (size_t i = 0; i < N; ++i)
+      for (size_t i = 0; i < N; ++i) {
+        // WUS: the update triad runs on the per-chip shard only —
+        // optimizer HBM traffic divides by the gradient-ring size
+        const Choice& c = assign[i];
+        double div = (c.wus && c.gradsync_k > 1) ? (double)c.gradsync_k
+                                                 : 1.0;
         upd_bytes += (double)g_.nodes[i].param_bytes() *
-                     (3.0 + 2.0 * opt_state_factor_);
+                     (3.0 + 2.0 * opt_state_factor_) / div;
+      }
       std::vector<int> deps = sync_ids;
       if (last_bwd >= 0) deps.push_back(last_bwd);
       SimTask ut{SimTask::Kind::Update, -1, upd_bytes / upd_bw, deps, "", 0};

@@ -113,6 +113,15 @@ struct Choice {
   int8_t gather_axis = kModel;         // mesh axis a Combine gathers over
   double gradsync_bytes = 0.0;         // per-iteration gradient allreduce bytes
   int gradsync_k = 1;                  // chips in the gradient ring (dp * sp)
+  bool wus = false;                    // weight-update sharding: gradsync runs
+                                       // as reduce-scatter + all-gather and the
+                                       // optimizer state shards over the ring
+  double bwd_psum_bytes = 0.0;         // backward-only partial-sum all-reduce
+                                       // (col-parallel dX; replicated scatter
+                                       // grads) over psum_axis
+  double wgather_bytes = 0.0;          // forward-only weight all-gather over
+                                       // psum_axis (tiny-batch row-parallel
+                                       // lowering moves the kernel, once)
   double ring_bytes = 0.0;             // K/V bytes a device sends over a full
                                        // ring-attention rotation (seq axis)
   int ring_k = 1;                      // seq-ring size (hop count = ring_k-1)
@@ -204,7 +213,8 @@ inline double sharded_param_bytes(const Node& n, const Choice& c,
 // gates the 2-D sample partition (--enable-sample-parallel, config.h:134).
 inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mesh,
                                              bool enable_pp,
-                                             bool enable_sp2 = true) {
+                                             bool enable_sp2 = true,
+                                             bool enable_wus = false) {
   using detail::div_ok;
   using detail::dp_spec;
   const int dp = mesh.dp, mp = mesh.mp;
@@ -273,6 +283,8 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     if (kit != n.params.end() && kit->second.size() == 2) {
       int64_t in_dim = kit->second[0], out_dim = kit->second[1];
       int eff_dp = dp_legal ? dp : 1;
+      double in_bytes = n.input_shapes.empty()
+          ? 0.0 : (double)shape_elems(n.input_shapes[0]) * n.dtype_size;
       if (div_ok(out_dim, mp)) {  // column parallel: Partition(out)+Combine
         Choice c = dp_legal ? make_dp() : base_choice("col");
         c.name = dp_legal ? "dp_col" : "col";
@@ -282,6 +294,12 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
+        // backward dX contracts over the model-sharded out dim: per-chip
+        // partials all-reduce (the Megatron pairing — col pays in bwd
+        // what row pays in fwd). Was unpriced; fflint FFL202 caught
+        // searched strategies emitting ARs the DP never costed (PR 3).
+        c.bwd_psum_bytes = in_bytes / eff_dp;
+        c.psum_k = mp;
         out.push_back(std::move(c));
       }
       if (div_ok(in_dim, mp)) {  // row parallel: Replicate+Reduction (psum)
@@ -295,6 +313,21 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
+        // tiny-batch regime: with fewer output rows per chip than one
+        // MXU tile edge, GSPMD resolves the row-parallel matmul by
+        // moving the WEIGHT — all-gather of the row-sharded kernel
+        // forward (once), all-reduce of the weight gradient backward
+        // (searched XDL emitted 7x the priced bytes this way, fflint
+        // FFL202 / ROADMAP). Rows = all output dims but the last (a
+        // [B,S,E] Linear runs B*S MXU rows, not B); at real batch sizes
+        // the term self-gates off.
+        double rows = oshp.empty()
+            ? 0.0 : (double)shape_elems(oshp) / oshp.back();
+        if (rows > 0 && rows / eff_dp <= 128.0 &&
+            (double)n.output_bytes(0) < detail::pbytes(n)) {
+          c.wgather_bytes += detail::pbytes(n);
+          c.bwd_psum_bytes += detail::pbytes(n);
+        }
         out.push_back(std::move(c));
       }
     }
@@ -320,7 +353,13 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.psum_bytes = (double)n.output_bytes(0) / eff_dp;
         c.psum_k = mp;
         c.work_div = static_cast<double>(eff_dp) * mp;
-        c.gradsync_bytes = detail::pbytes(n) / mp;
+        // XLA cannot keep the dkernel scatter vocab-sharded (the update
+        // rows are index-dependent): the gradient materializes replicated
+        // and all-reduces the FULL table over the model axis, and the
+        // data ring then carries full table bytes too — the ~7x
+        // underpricing fflint FFL202 flagged on searched XDL (ROADMAP).
+        c.bwd_psum_bytes = detail::pbytes(n);
+        c.gradsync_bytes = detail::pbytes(n);
         c.gradsync_k = eff_dp;
         out.push_back(std::move(c));
       }
@@ -330,6 +369,8 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
     if (kit != n.params.end() && kit->second.size() == 4) {
       int64_t oc = kit->second[0], ic = kit->second[1];
       int eff_dp = dp_legal ? dp : 1;
+      double in_bytes = n.input_shapes.empty()
+          ? 0.0 : (double)shape_elems(n.input_shapes[0]) * n.dtype_size;
       if (div_ok(oc, mp)) {
         Choice c = dp_legal ? make_dp() : base_choice("col");
         c.name = dp_legal ? "dp_col" : "col";
@@ -339,6 +380,10 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
+        // backward dX contracts over the channel-sharded out dim —
+        // same unpriced AR as the col-parallel Linear (FFL202, PR 3)
+        c.bwd_psum_bytes = in_bytes / eff_dp;
+        c.psum_k = mp;
         out.push_back(std::move(c));
       }
       if (div_ok(ic, mp)) {
@@ -351,6 +396,18 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
+        // tiny-batch weight movement, as in the row-parallel Linear:
+        // kernel all-gather fwd (once) + weight-grad all-reduce bwd.
+        // Conv MXU rows = N*H*W of the output.
+        double rows = n.output_shapes[0].size() == 4
+            ? (double)(n.output_shapes[0][0] * n.output_shapes[0][2] *
+                       n.output_shapes[0][3])
+            : (double)batch;
+        if (rows > 0 && rows / eff_dp <= 128.0 &&
+            (double)n.output_bytes(0) < detail::pbytes(n)) {
+          c.wgather_bytes += detail::pbytes(n);
+          c.bwd_psum_bytes += detail::pbytes(n);
+        }
         out.push_back(std::move(c));
       }
     }
@@ -578,6 +635,7 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div *= sp;
         // row-parallel partial sums shrink with the seq-sharded output
         if (c.psum_bytes > 0) c.psum_bytes /= sp;
+        if (c.bwd_psum_bytes > 0) c.bwd_psum_bytes /= sp;
         if (is_attn) {
           // K/V rotation cost: each device sends its projected K+V block
           // (sp-1) times around the seq ring. Block bytes = global K+V
@@ -602,6 +660,28 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         }
         out.push_back(std::move(c));
       }
+    }
+  }
+
+  // ---- weight-update sharding (WUS) variants ------------------------------
+  // Every choice that carries a data-ring gradient sync spawns a "_wus"
+  // twin: the sync prices as reduce-scatter + all-gather instead of an
+  // all-reduce, and the optimizer state (+ f32 master) shards over the
+  // ring — node_param_memory and the simulator's update-traffic term
+  // divide by gradsync_k. The DP weighs both forms per mesh, so WUS is a
+  // searched strategy dimension, not a global toggle (ISSUE 4).
+  // Twins only exist on meshes with a data ring: the executor shards the
+  // master/optimizer state over the DATA axes, so a pure-TP mesh (dp=1)
+  // has no shard dimension for WUS to use.
+  if (enable_wus && mesh.dp > 1) {
+    const size_t base_count = out.size();
+    for (size_t bi = 0; bi < base_count; ++bi) {
+      const Choice& b = out[bi];
+      if (b.gradsync_bytes <= 0 || b.gradsync_k <= 1) continue;
+      Choice c = b;
+      c.name += "_wus";
+      c.wus = true;
+      out.push_back(std::move(c));
     }
   }
   return out;
@@ -631,9 +711,16 @@ inline bool is_view_op(const std::string& t) {
 // analytic roofline; sharded work scales as measured/work_div. Backward is
 // measured separately — not assumed 2x forward — when the profiler provides
 // it.
+// `opt_state_factor >= 0` additionally folds the optimizer update-triad
+// time (read p/g, write p, + 2x per state copy, HBM-bound) into
+// nc.gradsync — for the frontier DP only, which otherwise cannot see the
+// per-chip update traffic a WUS choice divides by the gradient ring. The
+// taskgraph simulator prices its own global update task and passes the
+// default (-1) here.
 inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
                           const MachineModel& m, bool training,
-                          const MeasuredCosts* measured = nullptr) {
+                          const MeasuredCosts* measured = nullptr,
+                          double opt_state_factor = -1.0) {
   NodeCost nc;
   if (is_view_op(n.type)) return nc;  // fused away by XLA: free
   double div = std::max(1.0, c.work_div);
@@ -702,6 +789,14 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     double t = m.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
   }
+  if (training && c.bwd_psum_bytes > 0 && c.psum_k > 1)
+    // backward-only partial-sum all-reduce (col-parallel dX, replicated
+    // scatter gradients, tiny-batch weight-grad movement)
+    nc.comm += m.allreduce_time(c.bwd_psum_bytes, c.psum_k, c.psum_axis);
+  if (c.wgather_bytes > 0 && c.psum_k > 1)
+    // forward-only weight all-gather (tiny-batch row lowering) — charged
+    // once; its backward counterpart is the bwd_psum weight-grad AR
+    nc.comm += m.allgather_time(c.wgather_bytes, c.psum_k, c.psum_axis);
   if (c.ring_bytes > 0 && c.ring_k > 1) {
     // ring attention K/V rotation; the backward rotates K/V and dK/dV
     double t = m.ring_time(c.ring_bytes, c.ring_k, kSeq);
@@ -711,9 +806,28 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     double t = m.allgather_time(c.gather_bytes, c.gather_k, c.gather_axis);
     nc.comm += training ? 2.0 * t : t;  // bwd scatters the gradient back
   }
-  if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
-    nc.gradsync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                        slices_spanned(mesh, m), kData);
+  if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1) {
+    int spans = slices_spanned(mesh, m);
+    if (c.wus)
+      // WUS: reduce-scatter the gradients, update shard-locally, then
+      // all-gather the updated (bf16) compute params — roughly the
+      // all-reduce's wire bytes, but the optimizer update and its state
+      // shrink by gradsync_k (node_param_memory / the simulator's
+      // update-traffic term), which is where WUS wins.
+      nc.gradsync = m.wus_rs_time(c.gradsync_bytes, c.gradsync_k, spans,
+                                  kData) +
+                    m.wus_ag_time(c.gradsync_bytes, c.gradsync_k, spans,
+                                  kData);
+    else
+      nc.gradsync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
+                                          spans, kData);
+  }
+  if (training && opt_state_factor >= 0 && n.param_bytes() > 0) {
+    double upd = detail::sharded_param_bytes(n, c, mesh) *
+                 (3.0 + 2.0 * opt_state_factor) / m.hbm_bw;
+    if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
+    nc.gradsync += upd;
+  }
   return nc;
 }
 
@@ -723,7 +837,13 @@ inline double node_param_memory(const Node& n, const Choice& c,
                                 const MeshShape& mesh,
                                 double opt_state_factor) {
   if (is_view_op(n.type)) return 0.0;
-  return detail::sharded_param_bytes(n, c, mesh) * (1.0 + opt_state_factor);
+  double factor = 1.0 + opt_state_factor;
+  if (c.wus && c.gradsync_k > 1)
+    // weight-update sharding: the optimizer moments (and the f32 master
+    // they update) shard over the gradient ring; only the compute-param
+    // copy stays replicated
+    factor = 1.0 + opt_state_factor / c.gradsync_k;
+  return detail::sharded_param_bytes(n, c, mesh) * factor;
 }
 
 // Per-device activation bytes a node's outputs occupy while live.

@@ -38,10 +38,26 @@ class TestLocalBatchRows:
         np.testing.assert_array_equal(np.asarray(out), arr)
 
 
-@pytest.mark.slow
 class TestMultiProcess:
-    def test_two_process_gradient_sync_matches_single(self):
-        """2 procs x 2 virtual devices each == one 4-device process."""
+    def test_two_process_gradient_sync_and_hlo_order(self, tmp_path):
+        """2 procs x 1 virtual device == one 2-device process. Tier-1
+        since the worker moved from the unsupported jax_num_cpu_devices
+        knob to --xla_force_host_platform_device_count (ROADMAP item).
+        ``trace_dir`` additionally makes every worker dump its optimized
+        train-step HLO and the parent diff the per-host collective
+        sequences through fflint's FFL501/502 static deadlock pass —
+        run_dryrun raises if collection or ordering breaks."""
+        from flexflow_tpu.multihost_dryrun import run_dryrun
+
+        run_dryrun(num_processes=2, devices_per_proc=1,
+                   trace_dir=str(tmp_path))
+        assert (tmp_path / "train_step_host0.hlo.txt").exists()
+        assert (tmp_path / "train_step_host1.hlo.txt").exists()
+
+    @pytest.mark.slow
+    def test_two_process_multi_axis_legs(self):
+        """2 procs x 2 devices: the tp/ring/checkpoint legs whose model
+        and seq axes span hosts (heavier — slow tier)."""
         from flexflow_tpu.multihost_dryrun import run_dryrun
 
         run_dryrun(num_processes=2, devices_per_proc=2)
