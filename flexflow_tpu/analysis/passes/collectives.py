@@ -105,6 +105,13 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
     # non-divisible leaves keep a plain all-reduce with no gather
     wus_specs = (executor.wus_param_specs()
                  if wus_on and hasattr(executor, "wus_param_specs") else {})
+    # pipeline: stacked body params live 1/pp per device, so their
+    # grad-sync payloads divide by pp (per-device census convention —
+    # matches simulate_pipeline's body_gs_*/pp records)
+    pp = axis_sizes.get("pipe", 1)
+    pb = getattr(executor, "pb", None)
+    body_guids = ({ctx.nodes[i].op.guid for blk in pb.blocks for i in blk}
+                  if pp > 1 and pb is not None else set())
 
     for node in ctx.nodes:
         op = node.op
@@ -127,6 +134,7 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
             # computes identical grads on every device and needs no sync.
             st_choice = getattr(ctx.strategy.get(op.guid), "choice",
                                 None) or ""
+            stage_div = pp if op.guid in body_guids else 1
             if wus_on or "_wus" in st_choice:
                 # weight-update sharding: the sync is a reduce-scatter
                 # (XLA's AR-decomposition half — stays in the allreduce
@@ -142,12 +150,14 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
                         int(np.prod(shp))
                         for pname, shp in _param_shapes(op).items()
                         if pname in leaf_specs))
-                add("allreduce", nelem * elem, f"{op.name}:grad-rs")
+                add("allreduce", nelem * elem / stage_div,
+                    f"{op.name}:grad-rs")
                 if sharded > 0:
-                    add("allgather", sharded * elem,
+                    add("allgather", sharded * elem / stage_div,
                         f"{op.name}:wus-gather")
             else:
-                add("allreduce", nelem * elem, f"{op.name}:grad")
+                add("allreduce", nelem * elem / stage_div,
+                    f"{op.name}:grad")
         # row-parallel contractions produce partial sums -> psum: a
         # contraction-dim-sharded kernel (Linear in-dim, attention
         # head-dim on wo, embedding vocab-dim)
@@ -196,6 +206,30 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
                 and axis_sizes.get("expert", 1) > 1:
             add("reshard", float(np.prod(op.output_shapes[0])) * elem,
                 f"{op.name}:dispatch")
+    # pipeline parallelism: every tick ppermutes the in-flight microbatch
+    # activation one hop (backward: the returning gradient too); the
+    # sharded microbatch queue adds the input/output streams
+    if pp > 1 and pb is not None:
+        last = ctx.nodes[pb.blocks[-1][-1]]
+        shp = last.op.output_shapes[pb.body_out[2]]
+        M = int(getattr(executor, "microbatches", 0) or 2 * pp)
+        k = max(1, pb.num_blocks // pp)
+        rounds = k if getattr(executor, "schedule", "gpipe") == "circular" \
+            else 1
+        ticks = rounds * M + pp - 1
+        qshard = bool(getattr(executor, "shard_queue", False)) \
+            and M % pp == 0
+        # byte width: the op's declared dtype, matching the priced side
+        # (pipeline_meta_json ships block_out_bytes at op dtype into
+        # simulate_pipeline's census record) — NOT the compute dtype,
+        # which would diverge 2x under the bf16 regime
+        hop = float(np.prod(shp)) * last.op.dtype.size / (M * data_deg)
+        # sharded queue: 3 streams per tick + the pp-1 output-drain hops
+        # (must match simulate_pipeline's census record, or the
+        # priced-vs-inferred drift gate reports a permanent discrepancy)
+        hops = ticks * (3.0 if qshard else 1.0) + (pp - 1 if qshard else 0)
+        add("ppermute", hops * hop * (2.0 if training else 1.0),
+            "pipeline:hop")
     return out
 
 

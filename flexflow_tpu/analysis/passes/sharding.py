@@ -12,7 +12,16 @@ padded). This pass verifies, without compiling anything:
 * FFL103  a parameter spec is illegal against the op's parameter shapes;
 * FFL104  a parallel op (repartition/combine/replicate/reduction) is
           incompatible with its mesh axis or its producer's sharding;
-* FFL105  one spec uses the same mesh axis on two dims.
+* FFL105  one spec uses the same mesh axis on two dims;
+* FFL106  a pipe mesh whose stage count does not divide the repeated
+          blocks (or that has no repeated-block body at all);
+* FFL107  dropout/stateful ops inside the repeated blocks a pipe mesh
+          would pipeline (op state/rng cannot ride the shard_map body);
+* FFL108  the batch does not divide microbatches x data degree.
+
+The FFL106-108 family is the static form of the ValueErrors
+``PipelineGraphExecutor.__init__`` raises at compile time — lint
+surfaces them pre-compile with fix hints instead.
 
 Under weight-update sharding the pass additionally verifies the
 executor's sharded master/optimizer-state specs (``wus:<param>``
@@ -136,6 +145,73 @@ class ShardingLegalityPass:
                         f"param:{pname}"))
             diags.extend(self._check_parallel_op(node, ctx, axis_sizes))
         diags.extend(self._check_wus_specs(ctx, axis_sizes))
+        diags.extend(self._check_pipeline(ctx, axis_sizes))
+        return diags
+
+    # ---- pipeline legality on pipe meshes (FFL106-108) ---------------------
+    @staticmethod
+    def _check_pipeline(ctx, axis_sizes) -> List[Diagnostic]:
+        pp = axis_sizes.get("pipe", 1)
+        if pp <= 1:
+            return []
+        from flexflow_tpu.parallel.pipeline_detect import (
+            detect_repeated_blocks)
+        diags: List[Diagnostic] = []
+        pb = detect_repeated_blocks(ctx.nodes)
+        if pb is None:
+            # distinguish "repeated but stateful body" (FFL107) from
+            # "no repeated structure at all" (FFL106)
+            relaxed = detect_repeated_blocks(ctx.nodes, allow_stateful=True)
+            if relaxed is None:
+                diags.append(error(
+                    "FFL106",
+                    f"mesh carries a pipe axis ({pp}) but the graph has "
+                    f"no repeated-block body to pipeline",
+                    hint="pipeline parallelism needs a run of >= 2 "
+                         "structurally-identical shape-preserving blocks; "
+                         "drop the pipe axis or restructure the body"))
+                return diags
+            aux_types = {OperatorType.DROPOUT, OperatorType.EXPERTS,
+                         OperatorType.AGGREGATE,
+                         OperatorType.AGGREGATE_SPEC, OperatorType.GROUP_BY}
+            bad = sorted({
+                ctx.nodes[i].op.name
+                for blk in relaxed.blocks for i in blk
+                if hasattr(ctx.nodes[i].op, "init_state")
+                or getattr(ctx.nodes[i].op, "dropout", 0.0)
+                or ctx.nodes[i].op.op_type in aux_types})
+            diags.append(error(
+                "FFL107",
+                f"repeated blocks carry dropout/stateful ops "
+                f"({', '.join(bad[:4])}{', ...' if len(bad) > 4 else ''}) "
+                f"— op state/rng cannot ride the pipeline's shard_map "
+                f"body",
+                hint="remove dropout from the repeated body (or fold the "
+                     "stateful op) before pipelining, or drop the pipe "
+                     "axis"))
+            pb = relaxed  # divisibility checks still apply
+        if pb.num_blocks % pp:
+            diags.append(error(
+                "FFL106",
+                f"{pb.num_blocks} repeated blocks do not divide into "
+                f"{pp} pipeline stages",
+                hint=f"pick a pipe degree dividing {pb.num_blocks}, or "
+                     f"change the repeated-layer count"))
+        dp = 1
+        for ax in ("data", "replica"):
+            dp *= axis_sizes.get(ax, 1)
+        ex = getattr(ctx.ff, "executor", None) if ctx.ff is not None \
+            else None
+        M = int(getattr(ex, "microbatches", 0) or
+                getattr(ctx.config, "pipeline_microbatches", 0) or 2 * pp)
+        batch = ctx.nodes[pb.blocks[0][0]].op.output_shapes[0][0]
+        if batch % (M * dp):
+            diags.append(error(
+                "FFL108",
+                f"batch {batch} does not divide microbatches x data "
+                f"degree ({M} x {dp})",
+                hint="pick --pipeline-microbatches dividing batch/data "
+                     "(or 'auto', which sweeps the divisor lattice)"))
         return diags
 
     # ---- weight-update-sharding state specs -------------------------------

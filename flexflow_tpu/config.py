@@ -48,10 +48,19 @@ class FFConfig:
     search_overlap_backward_update: bool = False
     base_optimize_threshold: int = 10
     enable_substitution: bool = True  # graph-rewrite outer loop (GraphXfer)
-    # GPipe pipeline parallelism over a 'pipe' mesh axis on repeated-block
+    # Pipeline parallelism over a 'pipe' mesh axis on repeated-block
     # graphs (r4; the reference only stubs OP_PIPELINE, ffconst.h:153)
     enable_pipeline_parallel: bool = True
-    pipeline_microbatches: int = 0  # 0 = search over {1,2,4,8} * stages
+    # 0 = 'auto': the native search sweeps the divisor lattice of
+    # batch/(data degree) and the strategy records the argmin M
+    pipeline_microbatches: int = 0
+    # 'auto' follows the searched schedule (the simulator prices gpipe vs
+    # circular per mesh); 'gpipe'/'circular' force it
+    pipeline_schedule: str = "auto"
+    # shard the microbatch queue + output buffer over the pipe axis
+    # (~pp x less per-device activation memory); False keeps the
+    # replicated-queue lowering (A/B baseline)
+    pipeline_shard_queue: bool = True
     substitution_json: Optional[str] = None
     memory_search: bool = False
     memory_threshold_mb: Optional[int] = None
@@ -171,7 +180,18 @@ class FFConfig:
             elif a == "--disable-pipeline-parallel":
                 self.enable_pipeline_parallel = False
             elif a == "--pipeline-microbatches":
-                self.pipeline_microbatches = int(take())
+                v = take().lower()
+                # 'auto' = 0: follow the searched microbatch count
+                self.pipeline_microbatches = 0 if v == "auto" else int(v)
+            elif a == "--pipeline-schedule":
+                v = take().lower()
+                if v not in ("auto", "gpipe", "circular"):
+                    raise ValueError(
+                        f"--pipeline-schedule expects auto|gpipe|circular, "
+                        f"got {v!r}")
+                self.pipeline_schedule = v
+            elif a == "--pipeline-replicated-queue":
+                self.pipeline_shard_queue = False
             elif a == "--search-num-nodes":
                 self.num_nodes = int(take())
             elif a == "--search-num-workers":

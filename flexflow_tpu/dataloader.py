@@ -35,7 +35,13 @@ class SingleDataLoader:
         arr = np.asarray(full_array)
         bs = batch_size or ffmodel.input_tensors[0].shape[0]
         self.batch_size = bs  # global batch
-        sharding = ffmodel.executor.batch_sharding()
+        # labels stage on the loss-boundary layout (data-sharded), inputs
+        # on the executor's batch layout (pipe-sharded under the
+        # pipeline's sharded microbatch queue) — same contract as
+        # model._shard_batch, or the two staging paths would diverge
+        sharding = (ffmodel.executor.batch_sharding()
+                    if input_name is not None
+                    else ffmodel.executor.label_sharding())
         # multi-host: `full_array` is this process's dataset shard; each
         # batch consumes the local block of the global batch and the rows
         # assemble via make_array_from_process_local_data (host-resident —

@@ -525,3 +525,11 @@ class GraphExecutor:
     def batch_sharding(self):
         da = tuple(self.data_axes)
         return NamedSharding(self.mesh, P(da) if da else P())
+
+    def label_sharding(self):
+        """Sharding for staged label arrays. Defaults to the batch
+        sharding; executors that stage inputs in a different layout
+        (the pipeline's pipe-sharded microbatch queue) keep labels
+        data-sharded — labels only meet the loss, after the boundary
+        output is already back in the data layout."""
+        return self.batch_sharding()

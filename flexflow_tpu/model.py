@@ -711,8 +711,7 @@ class FFModel:
         searched_wus = searched and any(
             "_wus" in (getattr(st, "choice", None) or "")
             for st in (self.strategy or {}).values())
-        if (comp_mode == CompMode.INFERENCE or axes_now.get("pipe", 1) > 1
-                or wus_mode == "off"):
+        if comp_mode == CompMode.INFERENCE or wus_mode == "off":
             wus = False
         elif wus_mode == "on":
             wus = data_deg > 1
@@ -752,11 +751,19 @@ class FFModel:
                 pinfo = dict(blocks=pb,
                              microbatches=cfg.pipeline_microbatches
                              or 2 * axes_now["pipe"])
+            # precedence: explicit flags > searched values > auto
+            schedule = getattr(cfg, "pipeline_schedule", "auto")
+            if schedule == "auto" and pinfo.get("schedule"):
+                schedule = pinfo["schedule"]
+            microbatches = (cfg.pipeline_microbatches
+                            or int(pinfo.get("microbatches") or 0))
             self.executor = PipelineGraphExecutor(
                 nodes, input_names, final_ref, self.mesh, loss_type,
                 self.metrics, self.optimizer,
                 pipe_blocks=pinfo["blocks"],
-                microbatches=int(pinfo.get("microbatches") or 0),
+                microbatches=microbatches,
+                schedule=schedule,
+                shard_queue=getattr(cfg, "pipeline_shard_queue", True),
                 **exec_kwargs)
         else:
             self.layout_info = propagate_layouts(nodes, **self._layout_args)
@@ -794,7 +801,8 @@ class FFModel:
         self._declared_seq_cache = -1  # lazily derived (-1 = not yet)
 
     # ======================= data staging ==================================
-    def _shard_batch(self, arr: np.ndarray, cast: bool = False) -> jax.Array:
+    def _shard_batch(self, arr: np.ndarray, cast: bool = False,
+                     inputs: bool = False) -> jax.Array:
         arr = jnp.asarray(arr)
         if cast and jnp.issubdtype(arr.dtype, jnp.floating):
             # activations flow in the compute dtype end-to-end (bf16 on
@@ -802,7 +810,11 @@ class FFModel:
             # at the graph boundary halves every activation's HBM traffic.
             # Labels are staged without cast (loss math is f32).
             arr = arr.astype(self.executor.compute_dtype)
-        sharding = self.executor.batch_sharding()
+        # inputs stage on the executor's batch layout (pipe-sharded under
+        # the pipeline's sharded microbatch queue); labels stay on the
+        # data-sharded loss layout
+        sharding = (self.executor.batch_sharding() if inputs
+                    else self.executor.label_sharding())
         if jax.process_count() > 1:
             # multi-controller SPMD: `arr` is the rows THIS host feeds;
             # assemble the global batch from per-process shards
@@ -826,7 +838,8 @@ class FFModel:
         names = self.executor.input_names
         if len(xs) != len(names):
             raise ValueError(f"model has {len(names)} inputs, got {len(xs)} arrays")
-        return {n: self._shard_batch(x, cast=True) for n, x in zip(names, xs)}
+        return {n: self._shard_batch(x, cast=True, inputs=True)
+                for n, x in zip(names, xs)}
 
     # ======================= train / eval loops ============================
     def _make_tracer(self, trace_dir, run_name: str):

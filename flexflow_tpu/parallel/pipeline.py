@@ -1,24 +1,39 @@
-"""Pipeline parallelism: SPMD GPipe over a 'pipe' mesh axis.
+"""Pipeline parallelism: SPMD GPipe / circular pipelines over a 'pipe' axis.
 
 New executing scope vs the reference, where pipeline parallelism exists
 only as an enum value (`/root/reference/include/flexflow/ffconst.h:153`
 OP_PIPELINE, with no runtime behind it).
 
-TPU-native design (the MaxText/praxis recipe): a model whose body is S
-identical repeated stages stacks each stage's parameters on a leading
-[S, ...] axis sharded over the 'pipe' mesh axis. Under ``shard_map``
-every device holds one stage's weights; microbatch activations flow
-stage-to-stage with ``jax.lax.ppermute`` over the pipe ring. The GPipe
-schedule runs T = M + S - 1 ticks for M microbatches (bubble fraction
-(S-1)/T); each device computes on the microbatch that has reached its
-stage and forwards the result one hop. Backward is ordinary JAX autodiff
-through the shard_map — the transpose of ppermute is the reverse-ring
-ppermute, so the returning gradient pipeline falls out of jax.grad.
+TPU-native design (the MaxText/praxis recipe): a model whose body is R
+identical repeated blocks stacks each block's parameters on a leading
+[R, ...] axis sharded over the 'pipe' mesh axis. Under ``shard_map``
+every device holds R/S blocks' weights; microbatch activations flow
+stage-to-stage with ``jax.lax.ppermute`` over the pipe ring.
+
+Two schedules:
+
+* ``gpipe`` — each stage holds k = R/S *consecutive* blocks and runs all
+  of them per tick. T = M + S - 1 ticks for M microbatches; bubble
+  fraction (S-1)/T.
+* ``circular`` — blocks are assigned round-robin (stage s holds blocks
+  s, s+S, s+2S, ...) and each stage runs ONE block per tick; a
+  microbatch circulates the ring k times, re-entering stage 0 from a
+  recirculation buffer. T = kM + S - 1 ticks, shrinking the bubble to
+  (S-1)/(kM+S-1) — the MaxText circular-pipeline schedule.
+
+The microbatch queue and output buffer shard over the pipe axis
+(``shard_queue``): stage s holds only its M/S microbatches, and two
+single-microbatch ppermute streams carry inputs down to stage 0 and
+finished outputs back to their owning stage — per-device queue memory
+drops by ~S vs the replicated-queue lowering (kept as the fallback when
+S does not divide M).
+
+Backward is ordinary JAX autodiff through the shard_map — the transpose
+of ppermute is the reverse-ring ppermute, so the returning gradient
+pipeline falls out of jax.grad.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -26,34 +41,51 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from flexflow_tpu.utils.shard_map_compat import shard_map
 
+SCHEDULES = ("gpipe", "circular")
+
+
+def circular_block_order(num_blocks: int, num_stages: int):
+    """Storage-row order for ``schedule='circular'``: returns the list
+    ``order`` with ``order[row] = block index stored at that row``, such
+    that sharding the leading dim over S stages gives stage s the
+    round-robin blocks {s, s+S, s+2S, ...} with local slice r = round r's
+    block. Row s*k + r holds block r*S + s."""
+    k = num_blocks // num_stages
+    return [r * num_stages + s for s in range(num_stages) for r in range(k)]
+
 
 def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
                   axis: str = "pipe", data_axis: str = "data",
-                  stage_leading_dim: bool = False):
-    """Run ``stage_fn`` as an S-stage GPipe pipeline.
+                  stage_leading_dim: bool = False,
+                  schedule: str = "gpipe", shard_queue: bool = True):
+    """Run ``stage_fn`` as an S-stage SPMD pipeline.
 
     stage_fn(params_slice, x) -> y: one stage's computation; input and
         output must share shape/dtype (repeated-block models).
     stacked_params: pytree with leading dim R (a multiple of the ``axis``
         mesh size S), sharded over ``axis``. With R == S each stage holds
         one slice; ``stage_leading_dim=True`` keeps the local [R/S, ...]
-        leading dim and hands the whole local tree to stage_fn (a stage
-        running R/S blocks); False (default) squeezes it (R must equal S).
+        leading dim. Under ``schedule='gpipe'`` stage_fn then receives
+        the whole local tree (a stage running R/S consecutive blocks);
+        under ``schedule='circular'`` the rows must be in
+        ``circular_block_order`` and stage_fn receives ONE block's
+        squeezed slice per call (the round's block).
     x: [B, ...] global batch; B % num_microbatches == 0, and the
         microbatch size is the unit each stage processes per tick. When
         ``data_axis`` names a mesh axis, each microbatch additionally
         shards over it (pipeline x data composition).
+    shard_queue: shard the microbatch queue and output buffer over the
+        pipe axis (each stage holds M/S microbatches; per-tick ppermute
+        streams feed stage 0 and scatter finished outputs back). Falls
+        back to the replicated queue when S does not divide M.
     Returns y of x's shape: the last stage's outputs, gathered.
-
-    Memory note: the microbatch queue (and the output buffer) replicate
-    over the pipe axis — each stage device holds the full (data-sharded)
-    batch although it only computes on one in-flight microbatch. For
-    memory-bound deployments the queue should stream from stage 0 only;
-    that variant trades this implementation's simple SPMD schedule for a
-    sharded-queue one and is left as the optimization path.
     """
+    if schedule not in SCHEDULES:
+        raise ValueError(f"schedule must be one of {SCHEDULES}, "
+                         f"got {schedule!r}")
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = sizes[axis]
+    R = None
     for leaf in jax.tree.leaves(stacked_params):
         bad = (leaf.shape[0] % S != 0) if stage_leading_dim \
             else (leaf.shape[0] != S)
@@ -62,6 +94,7 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
                 f"stacked param dim 0 is {leaf.shape[0]} but the '{axis}' "
                 f"mesh axis has {S} stages — a mismatch would silently "
                 f"drop stages")
+        R = leaf.shape[0] if R is None else R
     M = num_microbatches
     if x.shape[0] % M:
         raise ValueError(f"batch {x.shape[0]} % microbatches {M} != 0")
@@ -70,53 +103,161 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
         raise ValueError(
             f"microbatch size {x.shape[0] // M} % '{data_axis}' axis "
             f"({sizes[data_axis]}) != 0")
+    # circular: one block per tick, k rounds around the ring; without a
+    # stage-leading dim there is exactly one round and the schedules
+    # coincide
+    circular = schedule == "circular" and stage_leading_dim
+    rounds = (R // S) if circular else 1
+    use_circ = rounds > 1  # recirculation buffer needed
+    if use_circ and M < S:
+        raise ValueError(
+            f"circular schedule needs microbatches >= stages "
+            f"({M} < {S}): a returning microbatch would overtake the "
+            f"recirculation buffer")
+    qsharded = shard_queue and M % S == 0
+    q = M // S if qsharded else M
+    ticks = rounds * M + S - 1
+    # the sharded output stream needs S-1 more hops to land the last
+    # microbatches on their owners — a separate compute-free drain loop
+    # (running stage_fn on garbage there would cost real backward
+    # residual memory for nothing)
+
+    down = [(i, (i - 1) % S) for i in range(S)]  # toward stage 0
+    up = [(i, (i + 1) % S) for i in range(S)]    # the pipeline direction
 
     def body(params, xs):
-        # params: [R/S, ...] this device's stage; xs: [M, B/M, ...]
-        # (replicated over pipe)
+        # params: this device's block slices; xs: [q, mb, ...] local
+        # queue slice (the full [M, ...] queue when replicated)
         idx = jax.lax.axis_index(axis)
-        p = params if stage_leading_dim \
-            else jax.tree.map(lambda w: w[0], params)
-        mb = xs.shape[1]
-        state = jnp.zeros((mb,) + xs.shape[2:], xs.dtype)  # in-flight act
         outs = jnp.zeros_like(xs)
+        z = jnp.zeros(xs.shape[1:], xs.dtype)
+
+        def block_params(r):
+            if circular:
+                return jax.tree.map(
+                    lambda w: jax.lax.dynamic_index_in_dim(
+                        w, r, 0, keepdims=False), params)
+            return params if stage_leading_dim \
+                else jax.tree.map(lambda w: w[0], params)
 
         def tick(t, carry):
-            state, outs = carry
-            # stage 0 ingests microbatch t (while it exists); others take
-            # the activation ppermuted from the previous stage
-            feed = jnp.where(t < M, t, M - 1)
-            inject = jax.lax.dynamic_index_in_dim(xs, feed, 0,
-                                                  keepdims=False)
-            cur = jnp.where(idx == 0, inject, state)
-            y = stage_fn(p, cur)
-            # the microbatch leaving the last stage this tick is t-(S-1)
-            done = t - (S - 1)
-            valid = jnp.logical_and(idx == S - 1,
-                                    jnp.logical_and(done >= 0, done < M))
-            slot = jnp.clip(done, 0, M - 1)
-            outs = jax.lax.dynamic_update_index_in_dim(
-                outs,
-                jnp.where(valid, y,
-                          jax.lax.dynamic_index_in_dim(outs, slot, 0,
-                                                       keepdims=False)),
-                slot, 0)
-            # forward the activation one hop around the pipe ring
-            state = jax.lax.ppermute(
-                y, axis, [(i, (i + 1) % S) for i in range(S)])
-            return state, outs
+            state, outs, circ, in_stream, out_stream = carry
+            # ---- input side: the microbatch entering stage 0 ----------
+            if qsharded:
+                # advance the input stream one hop toward stage 0, then
+                # inject the locally-held microbatch t+idx when this
+                # stage owns it (owner h(m) = m // q injects m at tick
+                # m - h(m); stage 0 then reads microbatch t at tick t)
+                in_stream = jax.lax.ppermute(in_stream, axis, down)
+                m_in = t + idx
+                owned = jnp.logical_and(m_in >= idx * q,
+                                        m_in < (idx + 1) * q)
+                li = jnp.clip(m_in - idx * q, 0, q - 1)
+                mine = jax.lax.dynamic_index_in_dim(xs, li, 0,
+                                                    keepdims=False)
+                in_stream = jnp.where(owned, mine, in_stream)
+                queue_feed = in_stream
+            else:
+                feed = jnp.clip(t, 0, M - 1)
+                queue_feed = jax.lax.dynamic_index_in_dim(
+                    xs, feed, 0, keepdims=False)
+            if use_circ:
+                # rounds >= 1 re-enter from the recirculation buffer
+                u0 = jnp.clip(t, 0, rounds * M - 1)
+                circ_feed = jax.lax.dynamic_index_in_dim(
+                    circ, u0 % M, 0, keepdims=False)
+                feed_val = jnp.where(t < M, queue_feed, circ_feed) \
+                    if qsharded else circ_feed
+            else:
+                feed_val = queue_feed
+            cur = jnp.where(idx == 0, feed_val, state)
+            # ---- compute: this stage's block for the current round ----
+            u = t - idx  # global step of the microbatch at this stage
+            r = jnp.clip(u, 0, rounds * M - 1) // M
+            y = stage_fn(block_params(r), cur)
+            # ---- output side: microbatch leaving its final round ------
+            u_last = t - (S - 1)                 # last stage's step
+            fin = u_last - (rounds - 1) * M      # finished microbatch
+            finished = jnp.logical_and(fin >= 0, fin < M)
+            if qsharded:
+                # out stream rides the ring away from the last stage;
+                # each stage captures the finished microbatches it owns
+                out_stream = jax.lax.ppermute(out_stream, axis, up)
+                out_stream = jnp.where(
+                    jnp.logical_and(idx == S - 1, finished), y, out_stream)
+                m_out = fin - ((idx + 1) % S)
+                owned_out = jnp.logical_and(m_out >= idx * q,
+                                            m_out < (idx + 1) * q)
+                lo = jnp.clip(m_out - idx * q, 0, q - 1)
+                prev = jax.lax.dynamic_index_in_dim(outs, lo, 0,
+                                                    keepdims=False)
+                outs = jax.lax.dynamic_update_index_in_dim(
+                    outs, jnp.where(owned_out, out_stream, prev), lo, 0)
+            else:
+                slot = jnp.clip(fin, 0, M - 1)
+                valid = jnp.logical_and(idx == S - 1, finished)
+                prev = jax.lax.dynamic_index_in_dim(outs, slot, 0,
+                                                    keepdims=False)
+                outs = jax.lax.dynamic_update_index_in_dim(
+                    outs, jnp.where(valid, y, prev), slot, 0)
+            # ---- forward the activation one hop around the pipe ring --
+            state = jax.lax.ppermute(y, axis, up)
+            if use_circ:
+                # stage 0 banks the returning activation for its next
+                # round (consumed M-S+1 ticks later — safe: M >= S)
+                u_arr = jnp.clip(t - (S - 1), 0, rounds * M - 1)
+                ok = jnp.logical_and(
+                    jnp.logical_and(t - (S - 1) >= 0,
+                                    u_arr // M < rounds - 1),
+                    idx == 0)
+                s_arr = u_arr % M
+                prevc = jax.lax.dynamic_index_in_dim(circ, s_arr, 0,
+                                                     keepdims=False)
+                circ = jax.lax.dynamic_update_index_in_dim(
+                    circ, jnp.where(ok, state, prevc), s_arr, 0)
+            return state, outs, circ, in_stream, out_stream
 
-        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (state, outs))
+        if use_circ and qsharded:
+            circ0 = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
+        elif use_circ:
+            circ0 = xs  # replicated queue doubles as the round-0 feed
+        else:
+            circ0 = jnp.zeros((1,) + xs.shape[1:], xs.dtype)  # unused
+        carry = (z, outs, circ0, z, z)
+        _, outs, _, _, out_stream = jax.lax.fori_loop(0, ticks, tick, carry)
+        if qsharded:
+            def drain_tick(j, carry):
+                outs, out_stream = carry
+                t = ticks + j
+                out_stream = jax.lax.ppermute(out_stream, axis, up)
+                fin = t - (S - 1) - (rounds - 1) * M
+                m_out = fin - ((idx + 1) % S)
+                owned_out = jnp.logical_and(m_out >= idx * q,
+                                            m_out < (idx + 1) * q)
+                lo = jnp.clip(m_out - idx * q, 0, q - 1)
+                prev = jax.lax.dynamic_index_in_dim(outs, lo, 0,
+                                                    keepdims=False)
+                outs = jax.lax.dynamic_update_index_in_dim(
+                    outs, jnp.where(owned_out, out_stream, prev), lo, 0)
+                return outs, out_stream
+
+            outs, _ = jax.lax.fori_loop(0, S - 1, drain_tick,
+                                        (outs, out_stream))
+            # each stage returns the finished microbatches it owns — the
+            # out_specs sharding assembles the global [M, ...] result
+            return outs
         # every device returns outs; only the last stage's is real — psum
         # after zeroing the others yields the replicated result
         outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
     pipe_spec = P(axis)
-    # microbatch dim replicated; the batch-within-microbatch dim shards
-    # over the data axis so pipeline x data composes (each data shard
-    # pipelines its slice of every microbatch)
-    x_spec = P(None, data_axis) if data_axis else P()
+    # queue layout: microbatch dim sharded over pipe (or replicated in
+    # the fallback); the batch-within-microbatch dim shards over the data
+    # axis so pipeline x data composes (each data shard pipelines its
+    # slice of every microbatch)
+    x_spec = P(axis if qsharded else None, data_axis) if data_axis \
+        else (P(axis) if qsharded else P())
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: pipe_spec, stacked_params), x_spec),
@@ -183,9 +324,13 @@ def transformer_block_stage(embed_dim: int, num_heads: int, seq_length: int,
     return init_fn, stage_fn
 
 
-def stack_stage_params(per_stage_params):
-    """[params_stage0, ..., params_stageS-1] (identical trees) -> one tree
-    with a leading [S, ...] axis, ready to shard over 'pipe'."""
+def stack_stage_params(per_stage_params, order=None):
+    """[params_block0, ..., params_blockR-1] (identical trees) -> one tree
+    with a leading [R, ...] axis, ready to shard over 'pipe'. ``order``
+    permutes the storage rows (``circular_block_order`` for the circular
+    schedule: row i holds block order[i])."""
+    if order is not None:
+        per_stage_params = [per_stage_params[b] for b in order]
     return jax.tree.map(lambda *ws: jnp.stack(ws), *per_stage_params)
 
 

@@ -116,13 +116,17 @@ def _block_signature(nodes, seg: List[int], boundary_in) -> Tuple:
     return tuple(sig)
 
 
-def detect_repeated_blocks(nodes, min_blocks: int = 2
+def detect_repeated_blocks(nodes, min_blocks: int = 2,
+                           allow_stateful: bool = False
                            ) -> Optional[PipelineBlocks]:
     """Longest run of >= min_blocks consecutive identical blocks, where a
     block is a periodic group of single-cut segments. Blocks must be
     shape-preserving (boundary-in shape == boundary-out shape) and
     stateless (no op with init_state — BN running stats cannot ride the
-    pipeline's shard_map in the current lowering)."""
+    pipeline's shard_map in the current lowering). ``allow_stateful``
+    drops the statelessness requirement — fflint's FFL107 rule uses it to
+    tell "repeated but unpipelineable (stateful/dropout body)" apart from
+    "no repeated structure at all"; the runtime never sets it."""
     if len(nodes) < 2:
         return None
     produced_at, last_use, input_last = _analyze(nodes)
@@ -134,9 +138,11 @@ def detect_repeated_blocks(nodes, min_blocks: int = 2
     segments = [list(range(bounds[s], bounds[s + 1])) for s in range(nseg)]
 
     def stateless(seg):
-        # the GPipe lowering cannot carry op state (BN running stats),
+        # the pipeline lowering cannot carry op state (BN running stats),
         # per-op rng (dropout), or auxiliary losses (MoE load balancing)
         # through the shard_map body — such blocks are not pipelineable
+        if allow_stateful:
+            return True
         from flexflow_tpu.ffconst import OperatorType
         aux_types = {OperatorType.EXPERTS, OperatorType.AGGREGATE,
                      OperatorType.AGGREGATE_SPEC, OperatorType.GROUP_BY,

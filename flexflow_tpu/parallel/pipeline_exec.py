@@ -3,17 +3,28 @@ search (or an explicit mesh) picks a 'pipe' axis.
 
 Completes the capability the reference only stubs (OP_PIPELINE,
 /root/reference/include/flexflow/ffconst.h:153): the repeated-block body
-of the graph executes as an SPMD GPipe pipeline (parallel/pipeline.py)
-while head/tail ops run under ordinary GSPMD around it. Body parameters
-live STACKED — params['__pipe_body__']['op<j>'] with leading dim
+of the graph executes as an SPMD pipeline (parallel/pipeline.py) while
+head/tail ops run under ordinary GSPMD around it. Body parameters live
+STACKED — params['__pipe_body__']['op<j>'] with leading dim
 R = num_blocks sharded over 'pipe' — so each device holds only its
 stage's R/S block slices (1/pp of the body weights, matching the native
 search's memory model, native/ffs_sim.hpp simulate_pipeline).
+
+Schedules (searched by the native cost model, ``--pipeline-schedule``):
+``gpipe`` keeps each stage's k = R/S blocks consecutive; ``circular``
+stores them round-robin (stage s holds blocks s, s+S, ...) and runs one
+block per tick, shrinking the bubble toward (S-1)/(kM+S-1).
+
+Weight-update sharding composes with the pipeline: the stacked body
+gradients reduce-scatter over the data axes onto a
+P('pipe', ..., 'data') master/optimizer-state layout, and the next
+step's compute params all-gather back inside the optimizer fusion —
+the same invariants as the flat executor (tests/test_wus.py).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +38,8 @@ BODY_KEY = "__pipe_body__"
 
 class PipelineGraphExecutor(GraphExecutor):
     def __init__(self, *args, pipe_blocks=None, microbatches: int = 0,
-                 pipe_axis: str = "pipe", **kwargs):
+                 pipe_axis: str = "pipe", schedule: str = "auto",
+                 shard_queue: bool = True, **kwargs):
         super().__init__(*args, **kwargs)
         if pipe_blocks is None:
             raise ValueError("PipelineGraphExecutor needs detected blocks")
@@ -42,13 +54,31 @@ class PipelineGraphExecutor(GraphExecutor):
             raise ValueError(
                 f"{R} repeated blocks cannot split into "
                 f"{self.num_stages} pipeline stages")
+        self.blocks_per_stage = R // self.num_stages
+        if schedule not in ("auto", "gpipe", "circular"):
+            raise ValueError(
+                f"pipeline schedule expects auto|gpipe|circular, "
+                f"got {schedule!r}")
         self.microbatches = microbatches or 2 * self.num_stages
-        batch = None
-        for ni in self.pb.blocks[0]:
-            batch = self.nodes[ni].op.output_shapes[0][0]
-            break
+        if schedule == "auto":
+            # circular only pays off (and only differs) with k > 1, and
+            # its recirculation buffer needs M >= S — 'auto' falls back
+            # to gpipe rather than rejecting a valid GPipe config
+            schedule = ("circular" if self.blocks_per_stage > 1
+                        and self.microbatches >= self.num_stages
+                        else "gpipe")
+        if schedule == "circular" and self.blocks_per_stage == 1:
+            schedule = "gpipe"  # identical schedule, natural storage order
+        self.schedule = schedule
+        self.shard_queue = bool(shard_queue)
+        if self.schedule == "circular" \
+                and self.microbatches < self.num_stages:
+            raise ValueError(
+                f"circular schedule needs microbatches >= stages "
+                f"({self.microbatches} < {self.num_stages})")
+        batch = self.nodes[self.pb.blocks[0][0]].op.output_shapes[0][0]
         dp = sizes.get("data", 1)
-        if batch is not None and batch % (self.microbatches * dp):
+        if batch % (self.microbatches * dp):
             raise ValueError(
                 f"batch {batch} must divide microbatches*data "
                 f"({self.microbatches}*{dp})")
@@ -57,23 +87,41 @@ class PipelineGraphExecutor(GraphExecutor):
                 op = self.nodes[ni].op
                 # backstop — detection already refuses these
                 # (pipeline_detect.stateless); a mismatch here means the
-                # blocks came from somewhere else
+                # blocks came from somewhere else. fflint surfaces the
+                # same condition pre-compile as FFL107.
                 if getattr(op, "dropout", 0.0) or hasattr(op, "init_state"):
                     raise ValueError(
                         f"op '{op.name}': dropout/stateful ops inside "
-                        f"pipelined blocks are not supported by the GPipe "
-                        f"lowering yet")
+                        f"pipelined blocks are not supported by the "
+                        f"pipeline lowering yet")
         self._head = [self.nodes[i] for i in self.pb.head]
         self._tail = [self.nodes[i] for i in self.pb.tail]
-        # map full op name -> (template param key, block index) for the
-        # per-layer weight I/O API (FFModel.get/set_parameter)
+        # map full op name -> (template param key, storage row) for the
+        # per-layer weight I/O API (FFModel.get/set_parameter). Under the
+        # circular schedule block b lives at row (b % S) * k + b // S so
+        # the pipe sharding hands stage s the round-robin set.
         self.body_param_map: Dict[str, tuple] = {}
         for b, blk in enumerate(self.pb.blocks):
             for j, ni in enumerate(blk):
-                self.body_param_map[self.nodes[ni].op.name] = (f"op{j}", b)
+                self.body_param_map[self.nodes[ni].op.name] = \
+                    (f"op{j}", self._storage_row(b))
+
+    def _storage_row(self, block_idx: int) -> int:
+        if self.schedule == "circular":
+            return (block_idx % self.num_stages) * self.blocks_per_stage \
+                + block_idx // self.num_stages
+        return block_idx
 
     # ---- parameters -------------------------------------------------------
     def init_params_and_state(self, rng):
+        from flexflow_tpu.parallel.pipeline import circular_block_order
+
+        # storage row -> block index (the inverse of _storage_row — the
+        # same permutation stack_stage_params callers use)
+        order = (circular_block_order(self.pb.num_blocks, self.num_stages)
+                 if self.schedule == "circular"
+                 else list(range(self.pb.num_blocks)))
+
         def _init(rng):
             p: Dict[str, Any] = {}
             for node in self._head + self._tail:
@@ -90,11 +138,13 @@ class PipelineGraphExecutor(GraphExecutor):
                     if ps:
                         bp[f"op{j}"] = ps
                 per_block.append(bp)
-            p[BODY_KEY] = jax.tree.map(lambda *ws: jnp.stack(ws), *per_block)
+            p[BODY_KEY] = jax.tree.map(
+                lambda *ws: jnp.stack([ws[b] for b in order]), *per_block)
             return p
 
         params = jax.jit(_init)(rng)
-        params = jax.device_put(params, self.param_shardings(params))
+        params = jax.device_put(params,
+                                self.param_shardings(params, master=True))
         state: Dict[str, Any] = {}
         for node in self._head + self._tail:
             if hasattr(node.op, "init_state"):
@@ -103,24 +153,118 @@ class PipelineGraphExecutor(GraphExecutor):
             state[COMPUTE_PARAMS_KEY] = self.cast_compute_copy(params)
         return params, state
 
-    def param_shardings(self, params):
+    # ---- weight-update sharding over the stacked body ---------------------
+    def _body_wus_spec(self, shape) -> Optional[P]:
+        """Master/optimizer-state spec for a stacked body leaf
+        [R, ...]: dim 0 carries the pipe axis; the data axes land on the
+        first later dim the data degree divides (None when no dim
+        divides — that leaf's state stays pipe-sharded only)."""
+        if not self.weight_update_sharding:
+            return None
+        deg = self._data_degree()
+        entries = [self.pipe_axis] + [None] * (len(shape) - 1)
+        for d in range(1, len(shape)):
+            if shape[d] > 0 and shape[d] % deg == 0:
+                entries[d] = self._wus_axis_entry()
+                return P(*entries)
+        return None
+
+    def _body_compute_spec(self, shape) -> P:
+        return P(self.pipe_axis, *([None] * (len(shape) - 1)))
+
+    def wus_param_specs(self) -> Dict[str, Dict[str, P]]:
+        """Per-op sharded-state specs fflint verifies. Body entries are
+        reported against the op's OWN (unstacked) parameter shapes: the
+        per-block slice of the master shards over the data axes on the
+        dim after the stacked leading dim."""
+        if not self.weight_update_sharding:
+            return {}
+        from flexflow_tpu.search.unity import _param_shapes
+        out: Dict[str, Dict[str, P]] = {}
+        body_rows = {n.op.name for blk in self.pb.blocks
+                     for n in (self.nodes[i] for i in blk)}
+        for node in self.nodes:
+            for pname, shp in _param_shapes(node.op).items():
+                if node.op.name in body_rows:
+                    spec = self._body_wus_spec((self.pb.num_blocks,)
+                                               + tuple(shp))
+                    if spec is not None:
+                        out.setdefault(node.op.name, {})[pname] = \
+                            P(*tuple(spec)[1:])
+                else:
+                    spec = self.wus_spec(node.op.name, pname, tuple(shp))
+                    if spec is not None:
+                        out.setdefault(node.op.name, {})[pname] = spec
+        return out
+
+    def _wus_shard(self, tree):
+        if not self.weight_update_sharding:
+            return tree
+
+        def leaf(path, x):
+            if not hasattr(x, "shape"):
+                return x
+            if path and getattr(path[0], "key", None) == BODY_KEY:
+                spec = self._body_wus_spec(x.shape)
+            elif len(path) >= 2:
+                spec = self.wus_spec(getattr(path[-2], "key", None),
+                                     getattr(path[-1], "key", None), x.shape)
+            else:
+                return x
+            if spec is None:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    def _constrain_compute(self, tree):
+        if not self.weight_update_sharding:
+            return tree
+
+        def leaf(path, x):
+            if not hasattr(x, "shape"):
+                return x
+            if path and getattr(path[0], "key", None) == BODY_KEY:
+                spec = self._body_compute_spec(x.shape)
+            elif len(path) >= 2:
+                node = self._by_name.get(getattr(path[-2], "key", None))
+                if node is None:
+                    return x
+                spec = node.param_specs.get(getattr(path[-1], "key", None),
+                                            P())
+            else:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    def param_shardings(self, params, master: bool = False):
         by_name = {n.op.name: n for n in self.nodes}
 
         def head_tail(op_name, sub):
-            node = by_name[op_name]
-            return {
-                pn: NamedSharding(self.mesh, node.param_specs.get(pn, P()))
-                for pn in sub
-            }
+            out = {}
+            for pn, arr in sub.items():
+                spec = by_name[op_name].param_specs.get(pn, P())
+                if master:
+                    w = self.wus_spec(op_name, pn,
+                                      tuple(getattr(arr, "shape", ())))
+                    if w is not None:
+                        spec = w
+                out[pn] = NamedSharding(self.mesh, spec)
+            return out
+
+        def body_leaf(w):
+            spec = self._body_wus_spec(w.shape) if master else None
+            if spec is None:
+                spec = self._body_compute_spec(w.shape)
+            return NamedSharding(self.mesh, spec)
 
         out = {}
         for op_name, sub in params.items():
             if op_name == BODY_KEY:
-                out[BODY_KEY] = jax.tree.map(
-                    lambda w: NamedSharding(
-                        self.mesh,
-                        P(self.pipe_axis, *([None] * (w.ndim - 1)))),
-                    sub)
+                out[BODY_KEY] = jax.tree.map(body_leaf, sub)
             else:
                 out[op_name] = head_tail(op_name, sub)
         return out
@@ -130,7 +274,6 @@ class PipelineGraphExecutor(GraphExecutor):
         """One block's ops (block-0 structure) on params slice ``pblock``."""
         tmpl = self.pb.blocks[0]
         values = {}
-        y = None
         for j, ni in enumerate(tmpl):
             node = self.nodes[ni]
             args = []
@@ -149,8 +292,15 @@ class PipelineGraphExecutor(GraphExecutor):
         return values[(last_guid, self.pb.body_out[2])]
 
     def _stage_fn(self, training: bool):
-        k = self.pb.num_blocks // self.num_stages
         ctx = OpContext(training=training, compute_dtype=self.compute_dtype)
+        if self.schedule == "circular" and self.blocks_per_stage > 1:
+            # circular: pipeline_spmd indexes the round's block slice and
+            # hands ONE block's params per tick
+            def stage_fn(p_block, x):
+                return self._run_block_template(p_block, x, ctx)
+
+            return stage_fn
+        k = self.blocks_per_stage
 
         def stage_fn(p_local, x):
             for b in range(k):
@@ -159,6 +309,29 @@ class PipelineGraphExecutor(GraphExecutor):
             return x
 
         return stage_fn
+
+    # ---- data staging -----------------------------------------------------
+    def batch_sharding(self):
+        # Sharded microbatch queue: when the pipeline consumes the graph
+        # input directly (no head ops), stage the batch sharded over the
+        # pipe axis too — reshaping [B, ...] to [M, B/M, ...] splits dim 0
+        # microbatch-major, so a dim-0 pipe shard IS the queue layout and
+        # the staged batch argument (alive for the whole step) drops by
+        # ~pp per device instead of replicating over the pipe axis.
+        # single-controller only: multi-process staging infers the global
+        # batch from per-host rows x the LABEL sharding's partitions, so
+        # inputs and labels must agree on the batch-dim layout there
+        if (self.shard_queue and self.microbatches % self.num_stages == 0
+                and self.pb.body_in[0] == "input" and not self._head
+                and jax.process_count() == 1):
+            da = tuple(self.data_axes)
+            return NamedSharding(self.mesh, P((self.pipe_axis,) + da))
+        return super().batch_sharding()
+
+    def label_sharding(self):
+        # labels never enter the pipeline; they meet the loss on the
+        # data-sharded boundary layout
+        return GraphExecutor.batch_sharding(self)
 
     # ---- graph traversal (head -> pipeline -> tail) -----------------------
     def run_graph(self, params, state, inputs, ctx: OpContext, nodes=None):
@@ -179,7 +352,19 @@ class PipelineGraphExecutor(GraphExecutor):
         y = pipeline_spmd(
             self._stage_fn(ctx.training), params[BODY_KEY], x, self.mesh,
             num_microbatches=self.microbatches, axis=self.pipe_axis,
-            data_axis="data", stage_leading_dim=True)
+            data_axis="data", stage_leading_dim=True,
+            schedule=self.schedule, shard_queue=self.shard_queue)
+        if ctx.training:
+            # pin the boundary back to the data-sharded layout the tail +
+            # loss run on: the queue layout (replicated or pipe-sharded)
+            # must not leak into the loss-reduction grouping, or schedule/
+            # queue variants drift at the last ulp instead of staying
+            # bit-identical. Forward-only executables skip the gather —
+            # the pipe-sharded output buffer is the memory win there.
+            da = tuple(self.data_axes)
+            spec = P(da) if da else P()
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(self.mesh, spec))
         values[(self.pb.body_out[1], self.pb.body_out[2])] = y
         self._run_nodes(self._tail, params, state, inputs, values,
                         new_state, aux, ctx)

@@ -292,6 +292,13 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 config, "enable_pipeline_parallel", True),
             pipeline_microbatches=getattr(
                 config, "pipeline_microbatches", 0),
+            # 'auto' lets the simulator price gpipe vs circular per mesh
+            # (the schedule is a searched dimension, ffs_sim.hpp)
+            pipeline_schedule=getattr(config, "pipeline_schedule", "auto"),
+            # --pipeline-replicated-queue: price the queue layout the
+            # lowering will actually emit (memory model differs by ~pp)
+            pipeline_shard_queue=getattr(config, "pipeline_shard_queue",
+                                         True),
             # --disable-fusion: gate the fuse_parallel_ops rewrite family
             # (kernel fusion itself belongs to XLA)
             perform_fusion=getattr(config, "perform_fusion", True),

@@ -148,13 +148,6 @@ def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
             choice = choice.replace("_wus", "")
         assignment[str(node.op.guid)] = choice
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
-    if axes.get("pipe", 1) > 1:
-        # the replay request below carries only data/model/seq/expert;
-        # feeding a pipeline-compiled model through it would price the
-        # wrong mesh and make the priced-vs-emitted diff meaningless
-        raise NotImplementedError(
-            "priced_collectives: pipeline strategies (pipe axis > 1) are "
-            "not supported by collective validation yet")
     req = dict(
         nodes=serialize_graph(nodes),
         machine=machine_to_json(ff.machine_spec, ff.mesh.devices.size),
@@ -162,10 +155,23 @@ def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
                     opt_state_factor=getattr(ff.config, "opt_state_factor",
                                              2.0)),
         mesh={"data": axes.get("data", 1), "model": axes.get("model", 1),
-              "seq": axes.get("seq", 1), "expert": axes.get("expert", 1)},
+              "seq": axes.get("seq", 1), "expert": axes.get("expert", 1),
+              "pipe": axes.get("pipe", 1)},
         assignment=assignment,
         measured={},
     )
+    if axes.get("pipe", 1) > 1:
+        # pipe meshes replay through simulate_pipeline: ship the detected
+        # repeated-block metadata plus the executor's actual microbatch
+        # count / schedule / queue layout so the priced census matches
+        # the program the lowering emits
+        from flexflow_tpu.parallel.pipeline_detect import pipeline_meta_json
+        ex = ff.executor
+        req["pipeline"] = dict(
+            pipeline_meta_json(nodes, ex.pb),
+            microbatches=int(ex.microbatches),
+            schedule=ex.schedule,
+            shard_queue=bool(ex.shard_queue))
     resp = native_simulate(req)
     out: Dict[str, float] = defaultdict(float)
     for t in resp.get("tasks", []):

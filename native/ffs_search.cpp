@@ -58,7 +58,11 @@ struct SearchConfig {
   bool enable_substitution = true;  // graph-rewrite outer loop
   bool enable_sample_parallel = true;  // 2-D batch partition (config.h:134)
   bool enable_pipeline_parallel = true;  // GPipe over a 'pipe' axis (r4)
-  int pipeline_microbatches = 0;    // 0 = auto (search over {1,2,4,8}*pp)
+  int pipeline_microbatches = 0;    // 0 = auto (sweep the divisor lattice
+                                    // of batch/dp inside the pipe eval)
+  std::string pipeline_schedule = "auto";  // auto | gpipe | circular
+  bool pipeline_shard_queue = true;  // price the sharded microbatch queue
+                                     // (--pipeline-replicated-queue = false)
   int subst_budget = 0;             // best-first expansions (0 = from budget)
   bool perform_fusion = true;       // fuse_parallel_ops rule family
                                     // (reference --disable-fusion)
@@ -83,6 +87,9 @@ struct SearchConfig {
     c.enable_sample_parallel = j.get("enable_sample_parallel").as_bool(true);
     c.enable_pipeline_parallel = j.get("enable_pipeline_parallel").as_bool(true);
     c.pipeline_microbatches = (int)j.get("pipeline_microbatches").as_int(0);
+    std::string sched = j.get("pipeline_schedule").as_string();
+    if (!sched.empty()) c.pipeline_schedule = sched;
+    c.pipeline_shard_queue = j.get("pipeline_shard_queue").as_bool(true);
     // best-first expansions scale with the user's budget (r5; the old
     // min(budget,16) cap could not exploit a 640-rule corpus)
     c.subst_budget = (int)j.get("subst_budget").as_int(
@@ -120,10 +127,10 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                     !cfg.only_data_parallel,
                                 cfg.enable_sample_parallel &&
                                     !cfg.only_data_parallel,
-                                // no WUS twins on pipe meshes: the GPipe
-                                // lowering keeps plain gradient sync
-                                cfg.enable_wus && cfg.training &&
-                                    mesh.pp <= 1);
+                                // WUS twins exist on pipe meshes too: the
+                                // pipeline executor reduce-scatters the
+                                // stacked body grads over the data axes
+                                cfg.enable_wus && cfg.training);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -512,8 +519,37 @@ struct GraphEval {
   std::vector<std::vector<Choice>> choices;
   SimResult sim;
   int64_t states = 0;
-  int pipe_microbatches = 0;  // > 0 when mesh.pp > 1
+  int pipe_microbatches = 0;      // > 0 when mesh.pp > 1
+  std::string pipe_schedule;      // "gpipe"|"circular" when mesh.pp > 1
 };
+
+// Candidate microbatch counts for a pipe mesh: the explicit flag, or the
+// divisor lattice of the per-data-replica batch (M must divide batch/dp
+// for microbatches to tile the data-sharded batch). Multiples of pp keep
+// the sharded microbatch queue; when none exist (tiny batches) every
+// divisor stays in play against the replicated-queue fallback.
+std::vector<int> microbatch_candidates(const SearchConfig& cfg,
+                                       const PipelineMeta& pipe,
+                                       const MeshShape& mesh) {
+  std::vector<int> out;
+  if (cfg.pipeline_microbatches > 0) {
+    out.push_back(cfg.pipeline_microbatches);
+    return out;
+  }
+  int64_t b = cfg.batch > 0 ? cfg.batch : pipe.batch;
+  int dp = std::max(1, mesh.dp);
+  if (b > 0 && b % dp == 0) {
+    int64_t q = b / dp;
+    for (int64_t M = 1; M <= q; ++M)
+      if (q % M == 0 && M % mesh.pp == 0) out.push_back((int)M);
+    if (out.empty())
+      for (int64_t M = 1; M <= q; ++M)
+        if (q % M == 0) out.push_back((int)M);
+  } else {
+    for (int f : {1, 2, 4, 8}) out.push_back(f * mesh.pp);
+  }
+  return out;
+}
 
 GraphEval eval_graph(const Graph& g, const MachineModel& m,
                      const SearchConfig& cfg, double threshold,
@@ -541,30 +577,41 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
     for (size_t i = 0; i < dp.assign.size(); ++i)
       cs0.push_back(choices[i][dp.assign[i]]);
     if (mesh.pp > 1) {
-      // GPipe wrapper around the inner-mesh DP result; pick the best
+      // pipeline wrapper around the inner-mesh DP result; both the
       // microbatch count (more microbatches shrink the bubble but also
-      // the per-tick tile efficiency, captured by the per-op floor)
-      std::vector<int> mcands;
-      if (cfg.pipeline_microbatches > 0) {
-        mcands.push_back(cfg.pipeline_microbatches);
+      // the per-tick tile efficiency, captured by the per-op floor) and
+      // the schedule (GPipe vs circular) are priced dimensions
+      int kblocks = pipe.num_blocks / mesh.pp;
+      std::vector<bool> scheds;
+      if (cfg.pipeline_schedule == "gpipe") {
+        scheds = {false};
+      } else if (cfg.pipeline_schedule == "circular") {
+        scheds = {true};
       } else {
-        for (int f : {1, 2, 4, 8}) mcands.push_back(f * mesh.pp);
+        scheds = {false};
+        if (kblocks > 1) scheds.push_back(true);
       }
-      for (int M : mcands) {
+      for (int M : microbatch_candidates(cfg, pipe, mesh)) {
         if (M < 1) continue;
         int64_t b = cfg.batch > 0 ? cfg.batch : pipe.batch;
         if (b > 0 && (b % ((int64_t)M * std::max(1, mesh.dp)))) continue;
-        SimResult sr = simulate_pipeline(g, mt, mesh, cs0, pipe, cfg.training,
-                                         cfg.opt_state_factor, &measured, M);
-        if (threshold > 0 && sr.memory > threshold) continue;
-        if (sr.iteration_time < ev.time) {
-          ev.time = sr.iteration_time;
-          ev.mesh = mesh;
-          ev.assign = dp.assign;
-          ev.choices = choices;
-          ev.sim = sr;
-          ev.ok = true;
-          ev.pipe_microbatches = M;
+        for (bool circ : scheds) {
+          // the circular runtime needs M >= stages (recirculation)
+          if (circ && kblocks > 1 && M < mesh.pp) continue;
+          SimResult sr = simulate_pipeline(
+              g, mt, mesh, cs0, pipe, cfg.training, cfg.opt_state_factor,
+              &measured, M, circ, cfg.pipeline_shard_queue);
+          if (threshold > 0 && sr.memory > threshold) continue;
+          if (sr.iteration_time < ev.time) {
+            ev.time = sr.iteration_time;
+            ev.mesh = mesh;
+            ev.assign = dp.assign;
+            ev.choices = choices;
+            ev.sim = sr;
+            ev.ok = true;
+            ev.pipe_microbatches = M;
+            ev.pipe_schedule = circ ? "circular" : "gpipe";
+          }
         }
       }
       continue;
@@ -760,6 +807,9 @@ Json optimize(const Json& req) {
     Json pj = Json::object();
     pj.set("microbatches", Json((int64_t)best.pipe_microbatches));
     pj.set("stages", Json((int64_t)best.mesh.pp));
+    pj.set("schedule", Json(best.pipe_schedule.empty()
+                                ? std::string("gpipe")
+                                : best.pipe_schedule));
     out.set("pipeline", pj);
   }
   Json ops = Json::object();
@@ -824,6 +874,10 @@ Json optimize(const Json& req) {
 }
 
 // Simulate a given assignment (for tests / what-if queries / --taskgraph).
+// A mesh with "pipe" > 1 routes through simulate_pipeline — the request's
+// "pipeline" object supplies the repeated-block metadata plus the
+// microbatch count and schedule to price, so searched pipe strategies
+// replay through the same cost model the DP ranked them with.
 Json simulate_only(const Json& req) {
   Graph g = Graph::from_json(req.get("nodes"));
   MachineModel m = MachineModel::from_json(req.get("machine"));
@@ -831,7 +885,8 @@ Json simulate_only(const Json& req) {
   MeshShape mesh{(int)req.get("mesh").get("data").as_int(1),
                  (int)req.get("mesh").get("model").as_int(1),
                  (int)req.get("mesh").get("seq").as_int(1),
-                 (int)req.get("mesh").get("expert").as_int(1)};
+                 (int)req.get("mesh").get("expert").as_int(1),
+                 (int)req.get("mesh").get("pipe").as_int(1)};
   m.assign_torus(mesh.dp, mesh.mp, mesh.sp, mesh.ep);
   auto choices = all_choices(g, mesh, cfg);
   std::vector<Choice> cs;
@@ -863,9 +918,30 @@ Json simulate_only(const Json& req) {
   MeasuredCosts measured;
   for (const auto& kv : req.get("measured").fields())
     measured[kv.first] = kv.second.as_double();
-  TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
-                         cfg.opt_state_factor, &measured);
-  SimResult r = sim.simulate(cs);
+  SimResult r;
+  if (mesh.pp > 1) {
+    PipelineMeta pipe = pipeline_meta_from_json(req.get("pipeline"));
+    if (!pipe.present)
+      throw std::runtime_error(
+          "mesh has pipe > 1 but the request carries no repeated-block "
+          "pipeline metadata");
+    const Json& pj = req.get("pipeline");
+    int M = (int)pj.get("microbatches").as_int(0);
+    if (M <= 0) M = cfg.pipeline_microbatches;
+    if (M <= 0) M = 2 * mesh.pp;
+    std::string sched = pj.get("schedule").as_string();
+    if (sched.empty()) sched = cfg.pipeline_schedule;
+    int kblocks = pipe.num_blocks / mesh.pp;
+    bool circ = sched == "circular" ||
+                (sched != "gpipe" && kblocks > 1 && M >= mesh.pp);
+    bool sq = pj.get("shard_queue").as_bool(cfg.pipeline_shard_queue);
+    r = simulate_pipeline(g, m, mesh, cs, pipe, cfg.training,
+                          cfg.opt_state_factor, &measured, M, circ, sq);
+  } else {
+    TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
+                           cfg.opt_state_factor, &measured);
+    r = sim.simulate(cs);
+  }
   Json out = Json::object();
   out.set("iteration_time", Json(r.iteration_time));
   out.set("memory", Json(r.memory));

@@ -311,71 +311,130 @@ struct PipelineMeta {
   int64_t batch = 0;
 };
 
-// Iteration time of the graph run as a pp-stage GPipe pipeline with M
+// Iteration time of the graph run as a pp-stage pipeline with M
 // microbatches, per-node inner choices `assign` (computed by the frontier
 // DP on the inner dp-only mesh). Model (parallel/pipeline.py semantics):
-//   * stages hold num_blocks/pp consecutive blocks; per-tick stage time is
-//     the body fwd (resp. bwd) cost / (pp * M), floored by per-op dispatch;
-//   * the schedule runs M + pp - 1 ticks forward and the same backward
-//     (bubble fraction (pp-1)/(M+pp-1));
+//   * `circular=false` (GPipe): stages hold k = num_blocks/pp consecutive
+//     blocks and run all of them per tick; T = M + pp - 1 ticks (bubble
+//     (pp-1)/T). `circular=true`: blocks assign round-robin, one block
+//     per tick, each microbatch circulates k rounds; T = kM + pp - 1
+//     ticks (bubble (pp-1)/(kM+pp-1)) — the schedule is a PRICED
+//     dimension, as are M (swept over the divisor lattice of batch/dp by
+//     the caller) and the per-op "_wus" gradient-sync twins;
 //   * each tick ppermutes the microbatch activation one hop (bwd: the
-//     returning gradient too);
+//     returning gradient too); the sharded microbatch queue
+//     (`shard_queue`, the runtime default when pp | M) adds two
+//     single-microbatch ppermute streams per tick plus pp-1 drain hops;
 //   * head/tail ops run outside the pipeline on the full batch;
 //   * stage weights shard 1/pp: gradient sync, optimizer update and
-//     parameter memory divide by pp; activations kept for backward divide
-//     by pp as well, but the microbatch queue + output buffer replicate
-//     over the pipe axis (the current lowering's documented memory
-//     caveat), charged as 2x the body boundary tensor.
+//     parameter memory divide by pp; a body/head choice with `wus` prices
+//     its sync as reduce-scatter + all-gather with the update triad and
+//     optimizer-state memory divided by the gradient ring;
+//   * queue memory: 2x the body boundary tensor over dp, divided by pp
+//     when the queue is sharded; the circular schedule adds a stage-0
+//     recirculation buffer (one boundary tensor over dp).
+// `res.tasks` carries zero-duration census records (collective, bytes) so
+// strategy replays (ffs_simulate) can diff priced vs inferred/emitted
+// collectives on pipe meshes too.
 inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
                                    const MeshShape& mesh,
                                    const std::vector<Choice>& assign,
                                    const PipelineMeta& meta, bool training,
                                    double opt_state_factor,
-                                   const MeasuredCosts* measured, int M) {
+                                   const MeasuredCosts* measured, int M,
+                                   bool circular = false,
+                                   bool shard_queue = true) {
   SimResult res;
   const int pp = mesh.pp;
+  const int k = pp > 0 ? std::max(1, meta.num_blocks / pp) : 1;
+  const int rounds = circular ? k : 1;
+  const bool qshard = shard_queue && pp > 0 && M % pp == 0;
   double fwd_body = 0, bwd_body = 0, fwd_edge = 0;
-  double body_params = 0, body_act = 0, body_gradsync_bytes = 0;
+  double body_act = 0, body_param_mem = 0;
+  double body_gs_plain = 0, body_gs_wus = 0;
   int body_ops = 0;
   int gradsync_k = mesh.dp;
-  double head_tail_time = 0, head_tail_params = 0, head_tail_act = 0,
-         head_tail_gradsync = 0;
+  double ht_time = 0, ht_param_mem = 0, ht_act = 0, ht_gradsync = 0;
+  double upd_bytes = 0;
   MeshShape inner = mesh;
   inner.pp = 1;
+  const int spans = slices_spanned(inner, m);
+  const double mem_f = training ? opt_state_factor : 0.0;
+  auto add_task = [&](SimTask::Kind kind, int node, double dur,
+                      const char* coll, double bytes) {
+    res.tasks.push_back(SimTask{kind, node, dur, {}, coll, bytes});
+  };
   for (size_t i = 0; i < g.nodes.size(); ++i) {
     const Node& n = g.nodes[i];
     const Choice& c = assign[i];
     NodeCost nc = node_cost(n, c, inner, m, training, measured);
-    double params = detail::sharded_param_bytes(n, c, inner);
+    double pmem = node_param_memory(n, c, inner, mem_f);
     double act = 0;
     for (size_t oi = 0; oi < n.output_shapes.size(); ++oi)
       act += (double)n.output_bytes(oi) /
              (oi < c.out.size() ? shards_of(c.out[oi], inner) : 1);
-    if (meta.body.count(n.guid)) {
+    const bool body = meta.body.count(n.guid) > 0;
+    if (body) {
       fwd_body += nc.fwd;
       bwd_body += nc.bwd;
       fwd_edge += nc.comm;
-      body_params += params;
+      body_param_mem += pmem;
       body_act += act;
-      if (c.gradsync_bytes > 0 && c.gradsync_k > 1)
-        body_gradsync_bytes += c.gradsync_bytes;
+      if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
+        (c.wus ? body_gs_wus : body_gs_plain) += c.gradsync_bytes;
       if (!is_view_op(n.type)) ++body_ops;
     } else {
-      head_tail_time += nc.fwd + nc.bwd + nc.comm;
-      head_tail_params += params;
-      head_tail_act += act;
-      if (c.gradsync_bytes > 0 && c.gradsync_k > 1)
-        head_tail_gradsync +=
-            m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                  slices_spanned(inner, m), kData);
+      ht_time += nc.fwd + nc.bwd + nc.comm;
+      ht_param_mem += pmem;
+      ht_act += act;
+      if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1) {
+        double t;
+        if (c.wus) {
+          t = m.wus_rs_time(c.gradsync_bytes, c.gradsync_k, spans, kData) +
+              m.wus_ag_time(c.gradsync_bytes, c.gradsync_k, spans, kData);
+          add_task(SimTask::Kind::GradSync, (int)i, 0, "allreduce",
+                   c.gradsync_bytes);
+          add_task(SimTask::Kind::GradSync, (int)i, 0, "allgather",
+                   c.gradsync_bytes);
+        } else {
+          t = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k, spans,
+                                    kData);
+          add_task(SimTask::Kind::GradSync, (int)i, 0, "allreduce",
+                   c.gradsync_bytes);
+        }
+        ht_gradsync += t;
+      }
     }
+    if (training && n.param_bytes() > 0) {
+      // optimizer update-triad HBM traffic: stage weights already /pp;
+      // WUS additionally divides by the gradient ring
+      double div = (c.wus && c.gradsync_k > 1) ? (double)c.gradsync_k : 1.0;
+      upd_bytes += detail::sharded_param_bytes(n, c, inner) /
+                   (body ? (double)pp : 1.0) *
+                   (3.0 + 2.0 * opt_state_factor) / div;
+    }
+    // per-op collective census records (durations already in nc.comm)
+    double psum_total = (training ? 2.0 : 1.0) * c.psum_bytes +
+                        (training ? c.bwd_psum_bytes : 0.0);
+    if (psum_total > 0 && c.psum_k > 1)
+      add_task(SimTask::Kind::Comm, (int)i, 0, "allreduce", psum_total);
+    if (c.gather_bytes > 0 && c.gather_k > 1)
+      add_task(SimTask::Kind::Comm, (int)i, 0, "allgather",
+               (training ? 2.0 : 1.0) * c.gather_bytes);
+    if (c.wgather_bytes > 0 && c.psum_k > 1)
+      add_task(SimTask::Kind::Comm, (int)i, 0, "allgather",
+               c.wgather_bytes);
+    if (c.ring_bytes > 0 && c.ring_k > 1)
+      add_task(SimTask::Kind::Comm, (int)i, 0, "ppermute",
+               (training ? 3.0 : 1.0) * c.ring_bytes);
   }
-  const double ticks = M + pp - 1;
+  const double ticks = (double)rounds * M + pp - 1;
   // per-tick stage compute, floored by the per-op dispatch minimum of the
-  // ops one stage executes per microbatch
-  double op_floor = (double)body_ops / pp * m.min_op_time;
-  double tick_fwd = std::max(fwd_body / (pp * M), op_floor);
-  double tick_bwd = std::max(bwd_body / (pp * M), op_floor);
+  // ops one stage executes per microbatch per tick (one block's worth
+  // under the circular schedule, k blocks' worth under GPipe)
+  double op_floor = (double)body_ops / (pp * rounds) * m.min_op_time;
+  double tick_fwd = std::max(fwd_body / ((double)pp * rounds * M), op_floor);
+  double tick_bwd = std::max(bwd_body / ((double)pp * rounds * M), op_floor);
   // activation hop: boundary tensor / (M * dp) per microbatch shard.
   // Each tick, every stage forwards simultaneously, so the tick's hop
   // cost is the slowest hop: if the pipeline's chip range extends past
@@ -387,40 +446,73 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   int inner_chips = mesh.dp * mesh.mp * mesh.sp * mesh.ep;
   bool spans_slices =
       m.num_slices > 1 && inner_chips * pp > m.chips_per_slice();
-  double hop = spans_slices ? (m.dcn_latency + hop_bytes / m.dcn_bw)
-                            : (m.ici_latency + hop_bytes / m.ici_bw);
-  res.fwd_time = ticks * (tick_fwd + hop) + fwd_edge;
-  res.comm_time = ticks * hop * (training ? 2.0 : 1.0) + fwd_edge;
+  double hop1 = spans_slices ? (m.dcn_latency + hop_bytes / m.dcn_bw)
+                             : (m.ici_latency + hop_bytes / m.ici_bw);
+  // sharded queue: the input and output streams are two more
+  // single-microbatch ppermutes riding the ring every tick, plus pp-1
+  // output-drain hops after the last compute tick. The streams are
+  // prefetch/writeback traffic (their payload is consumed S-1 ticks
+  // later), so they overlap compute and charge bandwidth only; the
+  // activation hop stays on the critical path with its latency.
+  double stream_bw = spans_slices ? m.dcn_bw : m.ici_bw;
+  double hop = hop1 + (qshard ? 2.0 * hop_bytes / stream_bw : 0.0);
+  double drain = qshard ? (pp - 1) * hop1 : 0.0;
+  add_task(SimTask::Kind::Comm, -1, 0, "ppermute",
+           (ticks * (qshard ? 3.0 : 1.0) + (qshard ? pp - 1 : 0)) *
+               meta.block_out_bytes / ((double)M * mesh.dp) *
+               (training ? 2.0 : 1.0));
+  res.fwd_time = ticks * (tick_fwd + hop) + drain + fwd_edge;
+  res.comm_time = ticks * hop * (training ? 2.0 : 1.0) + drain + fwd_edge;
   // fwd_edge (per-op collectives of body choices) charges iteration_time
   // too — pp>1 meshes must not be costed comm-free vs the taskgraph sim
-  res.iteration_time = head_tail_time + ticks * (tick_fwd + hop) + fwd_edge;
+  res.iteration_time =
+      ht_time + ticks * (tick_fwd + hop) + drain + fwd_edge;
   if (training) {
     res.bwd_time = ticks * (tick_bwd + hop);
     res.iteration_time += res.bwd_time;
-    if (mesh.dp > 1 && body_gradsync_bytes > 0)
-      res.gradsync_time = m.hier_allreduce_time(body_gradsync_bytes / pp,
-                                                gradsync_k,
-                                                slices_spanned(inner, m),
-                                                kData);
-    res.gradsync_time += head_tail_gradsync;
+    if (mesh.dp > 1 && body_gs_plain > 0) {
+      double t = m.hier_allreduce_time(body_gs_plain / pp, gradsync_k,
+                                       spans, kData);
+      res.gradsync_time += t;
+      add_task(SimTask::Kind::GradSync, -1, t, "allreduce",
+               body_gs_plain / pp);
+    }
+    if (mesh.dp > 1 && body_gs_wus > 0) {
+      // WUS twins under the pipeline: reduce-scatter the stage-sharded
+      // body grads over the data ring, all-gather the updated compute
+      // params — both on bytes/pp (the stage's stacked slice)
+      double t1 = m.wus_rs_time(body_gs_wus / pp, gradsync_k, spans, kData);
+      double t2 = m.wus_ag_time(body_gs_wus / pp, gradsync_k, spans, kData);
+      res.gradsync_time += t1 + t2;
+      add_task(SimTask::Kind::GradSync, -1, t1, "allreduce",
+               body_gs_wus / pp);
+      add_task(SimTask::Kind::GradSync, -1, t2, "allgather",
+               body_gs_wus / pp);
+    }
+    res.gradsync_time += ht_gradsync;
     res.iteration_time += res.gradsync_time;
     double upd_bw = m.hbm_bw;
     if (measured != nullptr) {
       auto it = measured->find("__update_bw__");
       if (it != measured->end() && it->second > 0) upd_bw = it->second;
     }
-    double upd_bytes = (body_params / pp + head_tail_params) *
-                       (3.0 + 2.0 * opt_state_factor);
     res.iteration_time += upd_bytes / upd_bw;
   }
   if (measured != nullptr) {
     auto it = measured->find("__step_overhead__");
     if (it != measured->end()) res.iteration_time += it->second;
   }
-  res.memory = (body_params / pp + head_tail_params) *
-                   (1.0 + (training ? opt_state_factor : 0.0)) +
-               (training ? body_act / pp + head_tail_act : 0.0) +
-               2.0 * meta.block_out_bytes / mesh.dp;  // queue + out buffer
+  // queue + output buffer: replicated over pipe in the fallback lowering,
+  // sharded 1/pp otherwise (plus the in/out stream microbatches); the
+  // circular schedule keeps a stage-0 recirculation buffer of one full
+  // (data-sharded) boundary tensor
+  double queue_mem =
+      2.0 * meta.block_out_bytes / mesh.dp / (qshard ? pp : 1);
+  if (rounds > 1) queue_mem += meta.block_out_bytes / mesh.dp;
+  if (qshard)
+    queue_mem += 3.0 * meta.block_out_bytes / ((double)M * mesh.dp);
+  res.memory = body_param_mem / pp + ht_param_mem +
+               (training ? body_act / pp + ht_act : 0.0) + queue_mem;
   return res;
 }
 

@@ -52,11 +52,14 @@ struct MeshShape {
   int mp = 1;  // model (tensor/attribute) axis
   int sp = 1;  // seq (context/ring) axis
   int ep = 1;  // expert axis
-  int pp = 1;  // pipe axis (GPipe stages; r4 — the reference only stubs
-               // OP_PIPELINE, ffconst.h:153). pp > 1 requires a
+  int pp = 1;  // pipe axis (pipeline stages; r4 — the reference only
+               // stubs OP_PIPELINE, ffconst.h:153). pp > 1 requires a
                // repeated-block graph; per-node choices then apply to the
                // inner (dp) mesh and the pipeline wraps them (ffs_sim.hpp
-               // simulate_pipeline).
+               // simulate_pipeline, which prices the GPipe-vs-circular
+               // schedule and the microbatch count as dimensions; "_wus"
+               // twins stay in play — the pipeline executor reduce-
+               // scatters the stacked body grads over the data axes).
   int axis_size(int8_t axis) const {
     switch (axis) {
       case kData: return dp;

@@ -502,6 +502,77 @@ class TestCompileWiring:
             FFConfig().parse_args(["--lint", "nonsense"])
 
 
+class TestPipelineLegality:
+    """FFL106-108: static pipeline legality on pipe meshes — the
+    conditions that otherwise surface as ValueErrors from
+    PipelineGraphExecutor.__init__ at compile time."""
+
+    _models = {}  # compiled fixtures shared across the class's tests
+
+    @classmethod
+    def _transformer(cls, layers=4, batch=16, dropout=0.0):
+        key = (layers, batch, dropout)
+        if key in cls._models:
+            return cls._models[key]
+        from flexflow_tpu.machine import make_mesh
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        cfg = TransformerConfig(num_layers=layers, hidden_size=32,
+                                num_heads=2, seq_length=8,
+                                batch_size=batch, dropout=dropout)
+        ff = create_transformer(cfg, FFConfig(batch_size=batch))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                   mesh=make_mesh(1, {"data": 1}))
+        cls._models[key] = ff
+        return ff
+
+    def _pipe_ctx(self, ff, axes, config=None):
+        from flexflow_tpu.machine import make_mesh
+        n = int(np.prod(list(axes.values())))
+        return LintContext(nodes=ff.executor.nodes,
+                           mesh=make_mesh(n, axes),
+                           strategy=ff.strategy, config=config)
+
+    def test_indivisible_blocks_fire_ffl106(self):
+        # 6 repeated blocks cannot split into 4 stages
+        ff = self._transformer(layers=6)
+        ctx = self._pipe_ctx(ff, {"pipe": 4})
+        diags = run_passes(ctx, [ShardingLegalityPass()]).errors
+        assert "FFL106" in rules(diags), [d.format() for d in diags]
+
+    def test_no_repeated_body_fires_ffl106(self):
+        ff = small_mlp()  # 128 -> 128 -> 10: not shape-preserving blocks
+        ctx = self._pipe_ctx(ff, {"pipe": 2, "data": 2})
+        diags = run_passes(ctx, [ShardingLegalityPass()]).errors
+        assert "FFL106" in rules(diags)
+
+    def test_dropout_in_blocks_fires_ffl107(self):
+        # detection refuses dropout bodies; the relaxed re-detection
+        # tells "stateful body" apart from "no repeated structure"
+        ff = self._transformer(layers=2, dropout=0.1)
+        ctx = self._pipe_ctx(ff, {"pipe": 2, "data": 2})
+        diags = run_passes(ctx, [ShardingLegalityPass()]).errors
+        assert "FFL107" in rules(diags)
+
+    def test_batch_indivisible_fires_ffl108(self):
+        ff = self._transformer(layers=6)  # shared with the FFL106 case
+        cfg = FFConfig(batch_size=16)
+        cfg.pipeline_microbatches = 16  # 16 % (16 * 2) != 0
+        ctx = self._pipe_ctx(ff, {"pipe": 2, "data": 2}, config=cfg)
+        diags = run_passes(ctx, [ShardingLegalityPass()]).errors
+        assert "FFL108" in rules(diags)
+
+    def test_legal_pipe_context_is_clean(self):
+        ff = self._transformer(layers=6)
+        cfg = FFConfig(batch_size=16)
+        cfg.pipeline_microbatches = 4
+        ctx = self._pipe_ctx(ff, {"pipe": 2, "data": 2}, config=cfg)
+        rep = run_passes(ctx, [ShardingLegalityPass()])
+        assert not {"FFL106", "FFL107", "FFL108"} & rules(rep.errors), \
+            [d.format() for d in rep.errors]
+
+
 class TestOrchestrator:
     def test_crashing_pass_reports_ffl000(self):
         class Boom:
