@@ -56,6 +56,13 @@ def time_train(ff, xs, y, iters, windows, tracer=None):
     thing being measured, so spans record dispatch cadence, and the
     window's host fetch is the only sync. None (the default) leaves the
     loop untouched.
+
+    Returns ``(samples_per_s, step_samples)`` where ``step_samples`` are
+    the per-step dispatch intervals (perf_counter deltas) of every
+    measured window — in the steady state the async pipeline backs up on
+    the device queue, so their distribution tracks device step time;
+    main() reports their p50/p99 next to the throughput number
+    (informational, no ratchet).
     """
     import jax.random as jrandom
 
@@ -87,16 +94,21 @@ def time_train(ff, xs, y, iters, windows, tracer=None):
     bs = ff.input_tensors[0].shape[0]
     best_dt = None
     final_loss = None
+    step_samples = []
     for _ in range(windows):
         t0 = time.perf_counter()
+        prev = t0
         for _ in range(iters):
             params, opt_state, state, rng, loss = step(params, opt_state,
                                                        state, rng)
+            now = time.perf_counter()
+            step_samples.append(now - prev)
+            prev = now
         final_loss = float(loss)  # sync: depends on the whole step chain
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
     assert np.isfinite(final_loss), f"training diverged: loss={final_loss}"
-    return bs * iters / best_dt
+    return bs * iters / best_dt, step_samples
 
 
 # ---------------------------------------------------------------------------
@@ -408,6 +420,35 @@ def hbm_peak_of(summary):
     return float(b) if b else None
 
 
+def step_time_stats(step_samples, iters):
+    """p50/p99 of the steady-state per-step dispatch intervals: the
+    first window (index < iters) still fills the async pipeline, so it
+    is dropped whenever a later window exists. Returns (p50, p99) or
+    (None, None)."""
+    from flexflow_tpu.obs.registry import percentile
+    s = step_samples[iters:] if len(step_samples) > iters else step_samples
+    if not s:
+        return None, None
+    s = sorted(s)
+    return percentile(s, 0.5), percentile(s, 0.99)
+
+
+def mfu_of(ff, step_s):
+    """Model-FLOPs utilization at the measured step time: analytic
+    fwd+bwd FLOPs per step / chips / step seconds / chip peak
+    (obs.devtrace.train_step_flops — same convention as the traced-run
+    MFU gauge). None when unavailable."""
+    try:
+        from flexflow_tpu.obs.devtrace import train_step_flops
+        spec = ff.machine_spec
+        if not (spec and step_s):
+            return None
+        n_chips = int(ff.mesh.devices.size)
+        return train_step_flops(ff) / n_chips / step_s / float(spec.flops)
+    except Exception:
+        return None
+
+
 def hbm_ratchet(hist, key, peak_bytes, tol=0.02):
     """HBM-peak ratchet per workload family, the memory sibling of
     ``census_ratchet``: XLA's compiled memory analysis is also a
@@ -457,8 +498,8 @@ def main():
             if trace_dir:
                 from flexflow_tpu.obs import make_tracer
                 tracer = make_tracer(trace_dir, run_name=name)
-            sps = time_train(ff, xs, y, iters=iters, windows=windows,
-                             tracer=tracer)
+            sps, step_samples = time_train(ff, xs, y, iters=iters,
+                                           windows=windows, tracer=tracer)
             summary = None
             if tracer is not None and tracer.active:
                 summary = emit_obs_artifacts(name, ff, tracer)
@@ -497,6 +538,22 @@ def main():
                 memory_regressions.append(
                     f"{name}: {hbm_peak:.0f} B peak vs recorded best "
                     f"{peak_base:.0f}")
+        # informational observability fields (ISSUE 6): step-time
+        # distribution + MFU next to the ratchets — recorded into the
+        # history entry for cross-round comparison, but NOT gated (chip
+        # weather swings dispatch cadence far more than compiled bytes)
+        p50, p99 = step_time_stats(step_samples, iters)
+        mfu = mfu_of(ff, p50)
+        if p50 is not None:
+            wl["step_time_p50"] = round(p50, 6)
+            wl["step_time_p99"] = round(p99, 6)
+        if mfu is not None:
+            wl["mfu"] = round(mfu, 8)
+        ent = hist.get(key)
+        if isinstance(ent, dict):
+            ent.update({k: wl[k] for k in
+                        ("step_time_p50", "step_time_p99", "mfu")
+                        if k in wl})
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",

@@ -112,6 +112,13 @@ class FFConfig:
     # search-drift calibration report into this directory. None = the
     # tracer is a shared no-op and the hot path pays nothing.
     trace_dir: Optional[str] = None
+    # windowed jax.profiler device-trace capture during fit: "A:B"
+    # profiles steps A..B-1 (python-slice convention; bare "N" = step N)
+    # and the obs devtrace layer attributes per-step device time into
+    # compute / collective / exposed-comms buckets, merged into the
+    # StepTracer Perfetto timeline. Needs --trace-dir (artifacts land
+    # there). None = no capture.
+    profile_steps: Optional[str] = None
 
     @property
     def num_devices(self) -> int:
@@ -230,6 +237,13 @@ class FFConfig:
                 self.profiling = True
             elif a == "--trace-dir":
                 self.trace_dir = take()
+            elif a == "--profile-steps":
+                v = take()
+                # validate eagerly: a bad window must fail at the CLI,
+                # not steps into the traced run it was meant to profile
+                from flexflow_tpu.obs.devtrace import parse_profile_steps
+                parse_profile_steps(v)
+                self.profile_steps = v
             elif a == "--conv-layout":
                 v = take().lower()
                 if v not in ("auto", "nhwc", "nchw"):

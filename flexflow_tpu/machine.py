@@ -122,6 +122,13 @@ class MachineSpec:
     # intermediate slices), the pair's bandwidth is the min link on the
     # path, and the ring's effective bandwidth is the bottleneck pair.
     dcn_links: Optional[Sequence[Tuple[int, int, float]]] = None
+    # measured per-collective-kind correction factors (kind ->
+    # measured/predicted ratio) from CALIBRATION.json
+    # ``collective_corrections`` — the device-trace attribution's
+    # calibration of these analytic ring formulas
+    # (scripts/calibrate.py --ingest-drift derives them; see
+    # load_collective_corrections). None/{} = uncalibrated.
+    collective_corrections: Optional[Dict[str, float]] = None
 
     def __post_init__(self):
         if self.torus is None:
@@ -303,27 +310,37 @@ class MachineSpec:
         compiled step's REAL collective census through the same machine
         model the search's simulator uses. Census bytes are
         per-partition (SPMD module), which matches these formulas'
-        per-chip payload convention."""
+        per-chip payload convention.
+
+        When ``collective_corrections`` carries a measured factor for
+        ``kind`` (device-trace attribution calibration,
+        ``scripts/calibrate.py --ingest-drift``), the analytic time is
+        scaled by it — the wus_rs/ag_time measured hook (ROADMAP chip
+        item (a))."""
         if num_chips <= 1:
             return 0.0
         if kind == "all-reduce":
-            return self.ici_allreduce_time(bytes_, num_chips)
-        if kind == "reduce-scatter":
+            t = self.ici_allreduce_time(bytes_, num_chips)
+        elif kind == "reduce-scatter":
             # first half of XLA's large-AR decomposition: half the AR
             # ring cost of the FULL payload. The census counted the op's
             # per-shard OUTPUT bytes (1/n of the reduced buffer), so
             # scale back up before applying the AR formula.
-            return self.ici_allreduce_time(bytes_ * num_chips,
-                                           num_chips) / 2
-        if kind == "all-gather":
-            return self.ici_allgather_time(bytes_, num_chips)
-        if kind == "all-to-all":
-            return self.ici_alltoall_time(bytes_, num_chips)
-        if kind == "collective-permute":
+            t = self.ici_allreduce_time(bytes_ * num_chips,
+                                        num_chips) / 2
+        elif kind == "all-gather":
+            t = self.ici_allgather_time(bytes_, num_chips)
+        elif kind == "all-to-all":
+            t = self.ici_alltoall_time(bytes_, num_chips)
+        elif kind == "collective-permute":
             # one neighbor hop, full payload over a bidirectional link
-            return self.ici_latency + bytes_ / (self.ici_bw * 2)
-        # unknown kind: price conservatively as an allreduce
-        return self.ici_allreduce_time(bytes_, num_chips)
+            t = self.ici_latency + bytes_ / (self.ici_bw * 2)
+        else:
+            # unknown kind: price conservatively as an allreduce
+            t = self.ici_allreduce_time(bytes_, num_chips)
+        if self.collective_corrections:
+            t *= self.collective_corrections.get(kind, 1.0)
+        return t
 
     def dcn_allreduce_time(self, bytes_: int) -> float:
         if self.num_slices <= 1:
@@ -340,8 +357,43 @@ class MachineSpec:
         return bytes_ / self.hbm_bw
 
 
+def load_collective_corrections(platform: str,
+                                path: Optional[str] = None
+                                ) -> Dict[str, float]:
+    """Measured per-collective-kind factors (kind -> measured/predicted
+    ratio) from CALIBRATION.json ``collective_corrections`` for one
+    PLATFORM bucket (the jax platform string that traced them, e.g.
+    "tpu"). Empty dict when the file or bucket is absent — callers
+    treat that as uncalibrated."""
+    import json
+    import os
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "CALIBRATION.json")
+    try:
+        with open(path) as f:
+            cal = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    bucket = (cal.get("collective_corrections") or {}).get(platform) or {}
+    out: Dict[str, float] = {}
+    for kind, e in bucket.items():
+        try:
+            out[kind] = float(e["factor"] if isinstance(e, dict) else e)
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
 def detect_machine_spec(num_devices: Optional[int] = None) -> MachineSpec:
-    """Build a MachineSpec from the live JAX backend (used at compile time)."""
+    """Build a MachineSpec from the live JAX backend (used at compile
+    time). On a real chip, measured per-collective calibration from
+    CALIBRATION.json engages automatically (platform-gated like
+    search/profile's op corrections; FFS_NO_DRIFT_CORRECTIONS opts
+    out) — CPU runs never pick up chip factors or vice versa."""
+    import os
+
     import jax
 
     devs = jax.devices()
@@ -357,7 +409,13 @@ def detect_machine_spec(num_devices: Optional[int] = None) -> MachineSpec:
         chip = "tpu-v6e"
     else:
         chip = "cpu-sim"
-    return MachineSpec(chip=chip, chips_per_slice=n)
+    spec = MachineSpec(chip=chip, chips_per_slice=n)
+    platform = devs[0].platform if devs else "cpu"
+    if platform != "cpu" and not os.environ.get("FFS_NO_DRIFT_CORRECTIONS"):
+        corr = load_collective_corrections(platform)
+        if corr:
+            spec.collective_corrections = corr
+    return spec
 
 
 def make_mesh(num_devices: int, axes: Dict[str, int]):

@@ -853,11 +853,26 @@ class FFModel:
             tracer.set_meta(**model_context(self))
         return tracer
 
-    def _finalize_trace(self, tracer, success: bool = True) -> None:
+    def _make_capture(self, tracer, profile_steps):
+        """Windowed jax.profiler device-trace capture (obs/devtrace):
+        the explicit ``profile_steps`` argument wins over ``Config
+        --profile-steps``; both unset (or no active tracer) returns the
+        shared no-op capture."""
+        from flexflow_tpu.obs import make_capture
+        return make_capture(tracer,
+                            profile_steps or self.config.profile_steps)
+
+    def _finalize_trace(self, tracer, success: bool = True,
+                        devtrace=None) -> None:
         """Export the trace + the compiled-step summary (XLA cost/memory
         analysis, collective census) + the search-drift calibration
         report. Observability failures warn instead of killing the
         training run that produced the data.
+
+        ``devtrace`` (an obs DeviceTraceCapture) is finalized FIRST so
+        its device lanes and per-step attribution counters land in the
+        exported Perfetto trace, and its measured per-collective times
+        join the drift report's census-priced predictions.
 
         ``success=False`` (the run raised) flushes only the trace and
         counters: the summary/drift reports need a fresh lower+compile
@@ -869,7 +884,20 @@ class FFModel:
         import os
         import sys
         from flexflow_tpu.obs import (drift_report, export_step_summary,
-                                      get_registry, write_artifact)
+                                      get_registry, record_step_metrics,
+                                      write_artifact)
+        devrep = None
+        if devtrace is not None and devtrace.active:
+            try:
+                devrep = devtrace.finalize(self, tracer)
+            except Exception as e:
+                print(f"[obs] device-trace attribution failed: {e!r}",
+                      file=sys.stderr)
+        step_metrics = None
+        try:
+            step_metrics = record_step_metrics(self, tracer)
+        except Exception as e:
+            print(f"[obs] step metrics failed: {e!r}", file=sys.stderr)
         try:
             tracer.export()
         except Exception as e:
@@ -887,7 +915,9 @@ class FFModel:
                 rep = drift_report(
                     self, tracer.step_time_s(),
                     census=(summary or {}).get("collectives"),
-                    phase_summary=tracer.phase_summary())
+                    phase_summary=tracer.phase_summary(),
+                    measured_collectives=(devrep or {}).get("collectives"),
+                    step_metrics=step_metrics)
                 write_artifact(stem + ".drift.json", rep,
                                host_id=tracer.host_id, kind="drift",
                                header_extra=extra)
@@ -903,7 +933,8 @@ class FFModel:
             print(f"[obs] counter export failed: {e!r}", file=sys.stderr)
 
     def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
-                    verbose: bool, on_epoch_start=None, tracer=None) -> float:
+                    verbose: bool, on_epoch_start=None, tracer=None,
+                    devtrace=None) -> float:
         """Shared epoch loop: per-batch jitted step, on-device metric
         accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
         report. ``next_batch(epoch, b)`` -> (inputs dict, labels).
@@ -917,19 +948,22 @@ class FFModel:
         instead of double-booking H2D under data_load), and each epoch
         ends with a metrics_sync span (the one host fetch of the
         accumulated metrics)."""
-        from flexflow_tpu.obs import NULL_TRACER
+        from flexflow_tpu.obs import NULL_CAPTURE, NULL_TRACER
         tracer = tracer or NULL_TRACER
+        devtrace = devtrace or NULL_CAPTURE
         train_step = self.executor.make_train_step()
         self._refresh_compute_params()
         start = time.time()
         loss = None
+        step_idx = -1  # global step index, the --profile-steps coordinate
         for epoch in range(epochs):
             if on_epoch_start is not None:
                 on_epoch_start()
             self._metrics_acc = PerfMetrics()
             mtotals = None
             for b in range(num_batches):
-                with tracer.step():
+                step_idx += 1
+                with tracer.step(), devtrace.step(step_idx):
                     inputs, labels = next_batch(epoch, b)
                     self._rng, sub = jax.random.split(self._rng)
                     with tracer.phase("dispatch"):
@@ -940,7 +974,7 @@ class FFModel:
                     self._iter += 1
                     mtotals = mvals if mtotals is None else jax.tree.map(
                         jnp.add, mtotals, mvals)
-                    if tracer.active:
+                    if tracer.active or devtrace.active:
                         with tracer.phase("device_wait"):
                             jax.block_until_ready(loss)
             with tracer.phase("metrics_sync", epoch=epoch):
@@ -959,7 +993,8 @@ class FFModel:
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, verbose: bool = True,
-            trace_dir: Optional[str] = None):
+            trace_dir: Optional[str] = None,
+            profile_steps: Optional[str] = None):
         """Keras-style whole-dataset training loop, streaming batches from
         host (base_model.py:376-430 / flexflow_cffi.py:2073-2086).
 
@@ -967,7 +1002,13 @@ class FFModel:
         observability subsystem: per-step Chrome-trace/JSONL artifacts,
         a compiled-step summary (XLA FLOPs/bytes/peak memory +
         collective census), and a search-drift calibration report land
-        in that directory when the loop finishes."""
+        in that directory when the loop finishes.
+
+        ``profile_steps`` (or ``Config --profile-steps``, e.g. "2:4")
+        additionally wraps that step window in a ``jax.profiler``
+        capture: device compute/collective lanes and per-step
+        compute/comms/exposed-comms attribution merge into the same
+        trace dir (obs/devtrace)."""
         epochs = epochs or self.config.epochs
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
@@ -980,6 +1021,7 @@ class FFModel:
             raise ValueError(
                 f"dataset of {n} samples is smaller than batch size {lbs}")
         tracer = self._make_tracer(trace_dir, "fit")
+        devtrace = self._make_capture(tracer, profile_steps)
 
         def next_batch(epoch, b):
             sl = slice(b * lbs, (b + 1) * lbs)
@@ -994,20 +1036,23 @@ class FFModel:
         # still flushes its trace — that trace is the diagnosis
         try:
             out = self._run_epochs(next_batch, num_batches, bs, epochs,
-                                   verbose, tracer=tracer)
+                                   verbose, tracer=tracer,
+                                   devtrace=devtrace)
         except BaseException:
-            self._finalize_trace(tracer, success=False)
+            self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise
-        self._finalize_trace(tracer)
+        self._finalize_trace(tracer, devtrace=devtrace)
         return out
 
     def fit_loader(self, loaders, epochs: Optional[int] = None,
-                   verbose: bool = True, trace_dir: Optional[str] = None):
+                   verbose: bool = True, trace_dir: Optional[str] = None,
+                   profile_steps: Optional[str] = None):
         """Steady-state training from staged on-device loaders
         (flexflow_tpu.dataloader) — no host→device traffic per step."""
         epochs = epochs or self.config.epochs
         bs = loaders.input_loaders[0].batch_size
         tracer = self._make_tracer(trace_dir, "fit")
+        devtrace = self._make_capture(tracer, profile_steps)
 
         def next_batch(e, b):
             with tracer.phase("data_load"):
@@ -1017,11 +1062,11 @@ class FFModel:
             out = self._run_epochs(next_batch, loaders.num_batches, bs,
                                    epochs, verbose,
                                    on_epoch_start=loaders.reset,
-                                   tracer=tracer)
+                                   tracer=tracer, devtrace=devtrace)
         except BaseException:
-            self._finalize_trace(tracer, success=False)
+            self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise
-        self._finalize_trace(tracer)
+        self._finalize_trace(tracer, devtrace=devtrace)
         return out
 
     # ---- checkpoint / resume (new scope vs reference — SURVEY §5.4) -------

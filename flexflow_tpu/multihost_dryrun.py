@@ -90,13 +90,16 @@ def _build(total_devices: int, leg: str = "dp"):
 
 
 def _build_and_train(total_devices: int, leg: str = "dp",
-                     trace_dir: Optional[str] = None):
+                     trace_dir: Optional[str] = None,
+                     profile_steps: Optional[str] = None):
     """Compile + train the dryrun model for _STEPS steps on this
     process's rows of the fixed global batch. Returns
     (FFModel, local_x, local_y) — the local slice is derived ONCE here
     and reused by callers (evaluate/predict legs). ``trace_dir``
     activates the obs step tracer; each process writes artifacts keyed
-    by its host id (jax.process_index)."""
+    by its host id (jax.process_index). ``profile_steps`` adds the
+    windowed jax.profiler device-trace capture, so each host's merged
+    Perfetto lanes include its own device compute/comms rows."""
     import jax
 
     ff = _build(total_devices, leg)
@@ -116,9 +119,10 @@ def _build_and_train(total_devices: int, leg: str = "dp",
         from flexflow_tpu.dataloader import create_data_loaders
         loaders = create_data_loaders(ff, lx, ly)
         ff.fit_loader(loaders, epochs=_STEPS, verbose=False,
-                      trace_dir=trace_dir)
+                      trace_dir=trace_dir, profile_steps=profile_steps)
     else:
-        ff.fit(lx, ly, epochs=_STEPS, verbose=False, trace_dir=trace_dir)
+        ff.fit(lx, ly, epochs=_STEPS, verbose=False, trace_dir=trace_dir,
+               profile_steps=profile_steps)
     return ff, lx, ly
 
 
@@ -165,9 +169,12 @@ def worker_main(process_id: int, num_processes: int, port: int,
         f"expected {num_processes * devices_per_proc} global devices, "
         f"got {total}")
     # per-host step tracing (FFS_TRACE_DIR, set by run_dryrun): each
-    # worker's fit writes *_hostNN artifacts the parent merges by host id
+    # worker's fit writes *_hostNN artifacts the parent merges by host
+    # id; FFS_PROFILE_STEPS adds the per-host device-trace capture
     trace_dir = os.environ.get("FFS_TRACE_DIR") or None
-    ff, lx, ly = _build_and_train(total, trace_dir=trace_dir)
+    profile_steps = os.environ.get("FFS_PROFILE_STEPS") or None
+    ff, lx, ly = _build_and_train(total, trace_dir=trace_dir,
+                                  profile_steps=profile_steps)
     if trace_dir:
         # per-host optimized-HLO dump for the fflint multihost-order pass
         # (FFL501/502 static deadlock detector): every process writes the
@@ -254,7 +261,8 @@ def _free_port() -> int:
 
 def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
                timeout: int = 600,
-               trace_dir: Optional[str] = None) -> None:
+               trace_dir: Optional[str] = None,
+               profile_steps: Optional[str] = None) -> None:
     """Spawn the workers, train, and assert parity with a single-process
     run on the same global batch. Raises on any mismatch.
 
@@ -262,7 +270,11 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
     JAX devices for the single-process reference leg. ``trace_dir``
     turns on per-host step tracing in every worker; after the workers
     exit their per-host Chrome traces are merged into one
-    ``merged.trace.json`` keyed by host id (pid = host in Perfetto)."""
+    ``merged.trace.json`` keyed by host id (pid = host in Perfetto).
+    ``profile_steps`` (with ``trace_dir``) additionally captures each
+    worker's device trace over that step window, so the merged timeline
+    shows every host's device compute/comms lanes on the shared
+    wall-clock epoch."""
     import jax
 
     total = num_processes * devices_per_proc
@@ -279,6 +291,10 @@ def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
             env["FFS_TRACE_DIR"] = trace_dir
         else:
             env.pop("FFS_TRACE_DIR", None)
+        if trace_dir and profile_steps:
+            env["FFS_PROFILE_STEPS"] = profile_steps
+        else:
+            env.pop("FFS_PROFILE_STEPS", None)
         # the per-process backend is configured inside worker_main via
         # jax config (not env), so a sitecustomize cannot override it
         env.pop("XLA_FLAGS", None)

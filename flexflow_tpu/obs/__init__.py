@@ -7,7 +7,11 @@ does flow back OUT — per-step phase spans (Chrome-trace/Perfetto JSON +
 a JSONL event stream), XLA cost/memory analysis and a collective census
 of the optimized HLO, and a drift report comparing the search's
 predicted step time against the measured one (consumable by
-scripts/calibrate.py). Cf. "A Learned Performance Model for TPUs" /
+scripts/calibrate.py). The devtrace layer (``--profile-steps``) adds a
+windowed ``jax.profiler`` capture attributing each step's DEVICE time
+into compute / collective / exposed-comms buckets, merged into the same
+Perfetto timeline and joined against the census-priced collectives for
+per-kind calibration. Cf. "A Learned Performance Model for TPUs" /
 SCALE-Sim (PAPERS.md): a calibrated performance model is only as good
 as its feedback loop.
 
@@ -17,7 +21,15 @@ training hot path pays nothing when observability is off.
 """
 
 from flexflow_tpu.obs.artifacts import artifact_header, write_artifact
-from flexflow_tpu.obs.drift import drift_report
+from flexflow_tpu.obs.devtrace import (
+    NULL_CAPTURE,
+    DeviceTraceCapture,
+    attribution_report,
+    make_capture,
+    parse_profile_steps,
+    record_step_metrics,
+)
+from flexflow_tpu.obs.drift import collective_drift, drift_report
 from flexflow_tpu.obs.inspect import (
     collective_census,
     export_step_summary,
@@ -43,6 +55,13 @@ from flexflow_tpu.obs.tracer import (
 __all__ = [
     "artifact_header",
     "write_artifact",
+    "NULL_CAPTURE",
+    "DeviceTraceCapture",
+    "attribution_report",
+    "make_capture",
+    "parse_profile_steps",
+    "record_step_metrics",
+    "collective_drift",
     "drift_report",
     "collective_census",
     "export_step_summary",

@@ -1,0 +1,599 @@
+"""Device-trace attribution: where a step's time goes ON THE DEVICE.
+
+The StepTracer records host-side wall time; this module closes the gap
+ROADMAP item (d) names — a windowed ``jax.profiler`` capture around a
+range of training steps, plus a stdlib-only parser that classifies the
+emitted Chrome-trace device spans into compute / collective / host-stall
+buckets and runs interval arithmetic per step:
+
+- ``compute_time``        union of device compute spans inside the step
+- ``comms_time``          union of collective spans (all-reduce,
+                          all-gather, reduce-scatter, collective-permute,
+                          all-to-all)
+- ``overlapped_comms``    comms time hidden under compute
+- ``exposed_comms``       comms the step actually waits on — the number
+                          the comms-compute-overlap direction ratchets
+
+Capture is windowed (``fit(profile_steps="A:B")`` / ``--profile-steps``)
+because a whole-run profile of a long job is gigabytes; a 2-4 step
+window is the steady-state sample. The CPU backend emits the same
+Chrome-trace JSON (``plugins/profile/*/*.trace.json.gz``) with per-op
+``args.hlo_op`` spans, so the whole pipeline runs devicelessly in
+tier-1. Steps are located inside the profile via
+``jax.profiler.StepTraceAnnotation`` markers the capture wraps around
+each step, which also give the host-clock correlation used to rebase
+device lanes onto the StepTracer timeline for the merged Perfetto view.
+
+Cf. "A Learned Performance Model for TPUs" (PAPERS.md 2008.01040): the
+per-collective measured times this produces are exactly the calibration
+signal the analytic simulator lacks — ``obs/drift.py`` joins them
+against the census-priced predictions and ``scripts/calibrate.py
+--ingest-drift`` folds the ratios into CALIBRATION.json.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.obs.inspect import COLLECTIVE_KINDS
+
+# the step marker the capture wraps around each training step; the
+# parser finds these annotations inside the profile to window device
+# spans per step (args.step_num carries the global step index)
+STEP_ANNOTATION = "ff_step"
+
+# HLO op-name prefixes bucketed as host stalls: device time spent
+# waiting on the host feed or cross-program transfers, not computing
+HOST_OP_PREFIXES = ("infeed", "outfeed", "send", "recv", "host-call")
+
+_KIND_RE = re.compile(
+    r"^(" + "|".join(COLLECTIVE_KINDS) + r"|collective-broadcast)"
+    r"(-start|-done)?(\.\d+)?$")
+
+# Perfetto lane tids for device events injected into the StepTracer
+# trace (tid 0 is the host train_loop): one lane per bucket, shared by
+# all local devices — the union semantics below treat them as one
+# device-time resource per host.
+TID_COMPUTE, TID_COMMS, TID_HOST = 64, 65, 66
+LANE_THREADS = {TID_COMPUTE: "device:compute", TID_COMMS: "device:comms",
+                TID_HOST: "device:host"}
+
+
+def parse_profile_steps(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``"A:B"`` -> capture steps A..B-1 (half-open, python-slice
+    convention); bare ``"N"`` -> just step N. None/"" -> no capture."""
+    if not spec:
+        return None
+    s = str(spec).strip()
+    try:
+        if ":" in s:
+            a, b = s.split(":", 1)
+            start, stop = int(a), int(b)
+        else:
+            start, stop = int(s), int(s) + 1
+    except ValueError:
+        raise ValueError(
+            f"--profile-steps expects 'A:B' or 'N', got {spec!r}")
+    if start < 0 or stop <= start:
+        raise ValueError(
+            f"--profile-steps window must satisfy 0 <= A < B, got {spec!r}")
+    return start, stop
+
+
+# ---------------------------------------------------------------------------
+# classification + interval arithmetic (stdlib only)
+
+
+def classify_hlo_op(name: str) -> Tuple[str, Optional[str]]:
+    """Bucket one device HLO op-name: ``("collective", kind)``,
+    ``("host", None)``, or ``("compute", None)``."""
+    m = _KIND_RE.match(name)
+    if m:
+        return "collective", m.group(1)
+    for p in HOST_OP_PREFIXES:
+        if name.startswith(p):
+            return "host", None
+    return "compute", None
+
+
+def merge_intervals(iv: List[Tuple[float, float]]
+                    ) -> List[Tuple[float, float]]:
+    """Union of half-open intervals, sorted and coalesced."""
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(iv):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def interval_total(merged: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in merged)
+
+
+def intersect_total(a: List[Tuple[float, float]],
+                    b: List[Tuple[float, float]]) -> float:
+    """Total overlap between two MERGED interval lists (two-pointer)."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace parsing
+
+
+def load_chrome_trace(path: str) -> Dict[str, Any]:
+    """Load a Chrome-trace JSON, gzipped (``*.trace.json.gz``, what
+    ``jax.profiler`` emits) or plain."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def locate_profile_traces(profile_dir: str) -> List[str]:
+    """The Chrome-trace files a ``jax.profiler`` session left under its
+    log dir (``plugins/profile/<session>/<host>.trace.json.gz``). When
+    repeated sessions share the dir, only the NEWEST session's files are
+    returned."""
+    sessions = sorted(glob.glob(os.path.join(profile_dir, "plugins",
+                                             "profile", "*")))
+    if not sessions:
+        return []
+    return sorted(glob.glob(os.path.join(sessions[-1], "*.trace.json*")))
+
+
+def extract_device_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Device op spans from a profiler Chrome trace.
+
+    An event is a device op when its args carry ``hlo_op``/``hlo_module``
+    (the CPU thunk executor stamps these) or when it sits under a
+    ``/device:`` process (real TPU lanes). Python-tracer frames
+    (``$``-prefixed) and runtime bookkeeping spans carry neither and are
+    dropped. Returns rows ``{name, ts, dur, bucket, kind}`` (µs)."""
+    device_pids = set()
+    for e in trace.get("traceEvents", []):
+        if (e.get("ph") == "M" and e.get("name") == "process_name"
+                and str((e.get("args") or {}).get("name", ""))
+                .startswith("/device:")):
+            device_pids.add(e.get("pid"))
+    out: List[Dict[str, Any]] = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name") or ""
+        args = e.get("args") or {}
+        if not (args.get("hlo_op") or args.get("hlo_module")
+                or e.get("pid") in device_pids):
+            continue
+        if name.startswith("$"):
+            continue
+        bucket, kind = classify_hlo_op(name)
+        out.append(dict(name=name, ts=float(e.get("ts", 0.0)),
+                        dur=float(e.get("dur", 0.0)),
+                        bucket=bucket, kind=kind))
+    return out
+
+
+def extract_step_windows(trace: Dict[str, Any],
+                         annotation: str = STEP_ANNOTATION
+                         ) -> Dict[int, Tuple[float, float]]:
+    """``{step_index: (ts, end)}`` (µs, profiler timebase) from the
+    StepTraceAnnotation markers the capture wrapped around each step."""
+    out: Dict[int, Tuple[float, float]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("name") != annotation:
+            continue
+        args = e.get("args") or {}
+        try:
+            step = int(args.get("step_num"))
+        except (TypeError, ValueError):
+            continue
+        t0 = float(e.get("ts", 0.0))
+        t1 = t0 + float(e.get("dur", 0.0))
+        if step in out:  # same step re-entered: span the union
+            t0 = min(t0, out[step][0])
+            t1 = max(t1, out[step][1])
+        out[step] = (t0, t1)
+    return out
+
+
+def attribute_steps(device_events: List[Dict[str, Any]],
+                    step_windows: Dict[int, Tuple[float, float]]
+                    ) -> List[Dict[str, Any]]:
+    """Per-step interval accounting over the device spans.
+
+    All local devices share one timeline per bucket (union semantics):
+    ``compute_s`` is wall time during which ANY device computes,
+    ``overlapped_comms_s`` is collective time hidden under that compute,
+    and ``exposed_comms_s = comms_s - overlapped_comms_s`` is what the
+    step waits on. Times in seconds."""
+    rows: List[Dict[str, Any]] = []
+    for step in sorted(step_windows):
+        t0, t1 = step_windows[step]
+        compute_iv: List[Tuple[float, float]] = []
+        comms_iv: List[Tuple[float, float]] = []
+        host_iv: List[Tuple[float, float]] = []
+        kind_iv: Dict[str, List[Tuple[float, float]]] = {}
+        kind_count: Dict[str, int] = {}
+        for ev in device_events:
+            s = max(ev["ts"], t0)
+            e = min(ev["ts"] + ev["dur"], t1)
+            if e <= s:
+                continue
+            if ev["bucket"] == "collective":
+                comms_iv.append((s, e))
+                kind_iv.setdefault(ev["kind"], []).append((s, e))
+                kind_count[ev["kind"]] = kind_count.get(ev["kind"], 0) + 1
+            elif ev["bucket"] == "host":
+                host_iv.append((s, e))
+            else:
+                compute_iv.append((s, e))
+        compute_u = merge_intervals(compute_iv)
+        comms_u = merge_intervals(comms_iv)
+        compute_s = interval_total(compute_u) / 1e6
+        comms_s = interval_total(comms_u) / 1e6
+        overlapped_s = intersect_total(comms_u, compute_u) / 1e6
+        host_s = interval_total(merge_intervals(host_iv)) / 1e6
+        busy_s = interval_total(
+            merge_intervals(compute_iv + comms_iv + host_iv)) / 1e6
+        wall_s = (t1 - t0) / 1e6
+        rows.append(dict(
+            step=step,
+            wall_s=wall_s,
+            compute_s=compute_s,
+            comms_s=comms_s,
+            overlapped_comms_s=overlapped_s,
+            exposed_comms_s=comms_s - overlapped_s,
+            host_s=host_s,
+            idle_s=max(wall_s - busy_s, 0.0),
+            per_kind={k: dict(
+                time_s=interval_total(merge_intervals(v)) / 1e6,
+                count=kind_count[k]) for k, v in kind_iv.items()},
+        ))
+    return rows
+
+
+def aggregate_attribution(per_step: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll per-step attribution rows up into run totals plus a
+    per-collective-kind summary (``{kind: {time_s, count, per_step_s}}``
+    — the measured half of the measured-vs-priced drift join)."""
+    n = len(per_step)
+    totals = dict(compute_s=0.0, comms_s=0.0, overlapped_comms_s=0.0,
+                  exposed_comms_s=0.0, host_s=0.0, idle_s=0.0, wall_s=0.0)
+    coll: Dict[str, Dict[str, float]] = {}
+    for row in per_step:
+        for k in totals:
+            totals[k] += row[k]
+        for kind, e in row["per_kind"].items():
+            c = coll.setdefault(kind, dict(time_s=0.0, count=0))
+            c["time_s"] += e["time_s"]
+            c["count"] += e["count"]
+    for c in coll.values():
+        c["per_step_s"] = c["time_s"] / n if n else 0.0
+    return dict(steps=n, totals=totals, collectives=coll)
+
+
+def _parse_traces(trace_paths: List[str],
+                  annotation: str = STEP_ANNOTATION):
+    """(device_events, step_windows) pooled over a capture's
+    Chrome-trace files (unreadable files are skipped — a half-written
+    profile must not kill the report)."""
+    events: List[Dict[str, Any]] = []
+    windows: Dict[int, Tuple[float, float]] = {}
+    for p in trace_paths:
+        try:
+            trace = load_chrome_trace(p)
+        except (OSError, ValueError):
+            continue
+        events += extract_device_events(trace)
+        windows.update(extract_step_windows(trace, annotation))
+    return events, windows
+
+
+def attribution_report(trace_paths: List[str],
+                       annotation: str = STEP_ANNOTATION) -> Dict[str, Any]:
+    """Parse + attribute one capture's Chrome-trace files.
+
+    Returns ``{per_step, steps, totals, collectives, device_events}``."""
+    events, windows = _parse_traces(trace_paths, annotation)
+    per_step = attribute_steps(events, windows)
+    return dict(per_step=per_step, device_events=len(events),
+                **aggregate_attribution(per_step))
+
+
+# ---------------------------------------------------------------------------
+# capture
+
+
+class NullCapture:
+    """Inert capture: the no-profile-window fast path."""
+
+    active = False
+    captured = False
+    _NULL = contextlib.nullcontext()
+
+    def step(self, step_index: int):
+        return self._NULL
+
+    def finalize(self, ff, tracer):
+        return None
+
+
+NULL_CAPTURE = NullCapture()
+
+
+class _CaptureStep:
+    """Per-step context: starts the profiler session when the window
+    opens, wraps the step in a StepTraceAnnotation while capturing, and
+    stops the session when the window closes — recording the host
+    perf_counter bracket of every annotated step for the clock
+    correlation the Perfetto lane merge needs."""
+
+    __slots__ = ("cap", "idx", "_ann", "_t0")
+
+    def __init__(self, cap, idx):
+        self.cap = cap
+        self.idx = idx
+        self._ann = None
+
+    def __enter__(self):
+        cap = self.cap
+        if cap.state == "idle" and self.idx >= cap.window[0]:
+            cap._start()
+        if cap.state == "capturing":
+            try:
+                import jax
+                self._ann = jax.profiler.StepTraceAnnotation(
+                    STEP_ANNOTATION, step_num=self.idx)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        cap = self.cap
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+            cap.host_steps[self.idx] = (self._t0, t1)
+        if cap.state == "capturing" and self.idx + 1 >= cap.window[1]:
+            cap._stop()
+        return False
+
+
+class DeviceTraceCapture:
+    """One windowed ``jax.profiler`` session around a step range.
+
+    Wrap each training step in ``capture.step(i)``; the session starts
+    when step ``window[0]`` begins and stops after step ``window[1]-1``
+    completes. ``finalize`` parses the emitted trace, writes the
+    ``.devtrace.json`` attribution artifact, feeds the counter registry,
+    and injects rebased device lanes + per-step attribution counter
+    tracks into the StepTracer's Perfetto output. Every profiler
+    interaction degrades to a warning — observability must never kill
+    the run it watches."""
+
+    active = True
+
+    def __init__(self, tracer, window: Tuple[int, int]):
+        self.tracer = tracer
+        self.window = window
+        self.profile_dir = os.path.join(tracer.trace_dir,
+                                        tracer.file_stem + ".jaxprof")
+        self.state = "idle"  # -> capturing -> done | failed
+        self.host_steps: Dict[int, Tuple[float, float]] = {}
+        self.trace_paths: List[str] = []
+
+    @property
+    def captured(self) -> bool:
+        return self.state == "done" and bool(self.trace_paths)
+
+    def step(self, step_index: int):
+        if self.state in ("done", "failed"):
+            return NullCapture._NULL
+        return _CaptureStep(self, step_index)
+
+    def _start(self) -> None:
+        try:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            self.state = "capturing"
+        except Exception as e:
+            import sys
+            print(f"[obs] device-trace capture failed to start ({e!r}); "
+                  "profiling disabled for this run", file=sys.stderr)
+            self.state = "failed"
+
+    def _stop(self) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            self.state = "done"
+            self.trace_paths = locate_profile_traces(self.profile_dir)
+            if not self.trace_paths:
+                import sys
+                print(f"[obs] profiler session left no Chrome trace under "
+                      f"{self.profile_dir}", file=sys.stderr)
+        except Exception as e:
+            import sys
+            print(f"[obs] device-trace capture failed to stop ({e!r})",
+                  file=sys.stderr)
+            self.state = "failed"
+
+    # ---- post-run ----------------------------------------------------------
+    def _clock_shift_us(self, step_windows) -> float:
+        """Profiler-timebase -> tracer-timeline shift, averaged over
+        every step seen by both clocks (the host perf_counter bracket
+        recorded around each annotation vs the annotation's own span in
+        the profile)."""
+        origin = getattr(self.tracer, "_origin", None)
+        if origin is None:
+            return 0.0
+        shifts = [
+            (t0 - origin) * 1e6 - step_windows[idx][0]
+            for idx, (t0, _) in self.host_steps.items()
+            if idx in step_windows]
+        return sum(shifts) / len(shifts) if shifts else 0.0
+
+    def finalize(self, ff, tracer) -> Optional[Dict[str, Any]]:
+        """Parse + attribute, emit the artifact, merge Perfetto lanes.
+        Returns the attribution report (None when nothing was captured).
+        Must run BEFORE ``tracer.export()`` so the device lanes land in
+        the exported trace."""
+        if self.state == "capturing":  # run ended inside the window
+            self._stop()
+        if not self.captured:
+            return None
+        events, windows = _parse_traces(self.trace_paths)
+        per_step = attribute_steps(events, windows)
+        report = dict(
+            window=list(self.window),
+            profile_dir=self.profile_dir,
+            trace_files=[os.path.relpath(p, tracer.trace_dir)
+                         for p in self.trace_paths],
+            per_step=per_step,
+            device_events=len(events),
+            **aggregate_attribution(per_step),
+        )
+        # registry: exposed-comms / compute distributions survive into
+        # the counters snapshot (bounded reservoir, registry.observe)
+        from flexflow_tpu.obs.registry import get_registry
+        reg = get_registry()
+        run = tracer.run_name
+        for row in per_step:
+            reg.observe(f"{run}/devtrace_compute_s", row["compute_s"])
+            reg.observe(f"{run}/devtrace_exposed_comms_s",
+                        row["exposed_comms_s"])
+        tot = report["totals"]
+        if tot["wall_s"] > 0:
+            reg.gauge(f"{run}/devtrace_exposed_comms_frac",
+                      tot["exposed_comms_s"] / tot["wall_s"])
+            reg.gauge(f"{run}/devtrace_compute_frac",
+                      tot["compute_s"] / tot["wall_s"])
+        # Perfetto lanes: device spans + per-step attribution counters,
+        # rebased from the profiler timebase onto the tracer timeline
+        shift = self._clock_shift_us(windows)
+        lane_events: List[Dict[str, Any]] = []
+        tid_of = {"compute": TID_COMPUTE, "collective": TID_COMMS,
+                  "host": TID_HOST}
+        for ev in events:
+            ce = dict(name=ev["name"], ph="X", tid=tid_of[ev["bucket"]],
+                      ts=round(ev["ts"] + shift, 3),
+                      dur=round(ev["dur"], 3), cat="devtrace")
+            if ev["kind"]:
+                ce["args"] = dict(kind=ev["kind"])
+            lane_events.append(ce)
+        for row in per_step:
+            t0 = windows[row["step"]][0] + shift
+            lane_events.append(dict(
+                name="step_attribution", ph="C", tid=0,
+                ts=round(t0, 3), cat="devtrace",
+                args=dict(compute_ms=round(row["compute_s"] * 1e3, 4),
+                          overlapped_comms_ms=round(
+                              row["overlapped_comms_s"] * 1e3, 4),
+                          exposed_comms_ms=round(
+                              row["exposed_comms_s"] * 1e3, 4))))
+        tracer.add_trace_events(lane_events, dict(LANE_THREADS))
+        from flexflow_tpu.obs.artifacts import write_artifact
+        stem = os.path.join(tracer.trace_dir, tracer.file_stem)
+        write_artifact(stem + ".devtrace.json", report,
+                       host_id=tracer.host_id, kind="devtrace",
+                       header_extra=dict(run_name=tracer.run_name,
+                                         run_seq=tracer.run_seq))
+        return report
+
+
+def make_capture(tracer, profile_steps: Optional[str]):
+    """A DeviceTraceCapture over the parsed window, or the shared no-op.
+
+    Needs an ACTIVE tracer (the artifacts land in its trace dir and the
+    lanes merge into its Perfetto output): a profile window without a
+    trace dir warns and degrades rather than raising mid-fit."""
+    window = parse_profile_steps(profile_steps)
+    if window is None:
+        return NULL_CAPTURE
+    if not getattr(tracer, "active", False):
+        import sys
+        print("[obs] --profile-steps needs --trace-dir (device-trace "
+              "artifacts land in the trace dir); profiling skipped",
+              file=sys.stderr)
+        return NULL_CAPTURE
+    return DeviceTraceCapture(tracer, window)
+
+
+# ---------------------------------------------------------------------------
+# goodput / MFU step metrics (registry + drift report surface)
+
+
+def train_step_flops(ff) -> float:
+    """Model FLOPs of one training step: analytic per-op forward FLOPs
+    (the roofline machinery's ``op.flops()``) x3 for fwd+bwd — the same
+    fwd:bwd convention the drift predictor uses. Global (whole-batch)
+    FLOPs; divide by chip count for per-chip."""
+    return 3.0 * sum(float(n.op.flops()) for n in ff.executor.nodes)
+
+
+def record_step_metrics(ff, tracer, registry=None) -> Dict[str, Any]:
+    """Step-time histogram + goodput + MFU into the counter registry.
+
+    - ``<run>/step_time_s`` observations (p50/p99 survive into the
+      counters snapshot via the registry's bounded reservoir)
+    - ``<run>/goodput`` gauge: productive-step time / run wall time —
+      what fraction of the traced run the device spent inside steps
+    - ``<run>/mfu`` gauge: model FLOPs per step / chips / median step
+      time / chip peak FLOPs (meaningful on TPU; on cpu-sim it is
+      relative to the synthetic 1 TFLOP/s peak)
+    Returns the same numbers as a dict for the drift report."""
+    from flexflow_tpu.obs.registry import get_registry, percentile
+    if registry is None:
+        registry = get_registry()
+    run = tracer.run_name
+    ds = tracer.step_durations_s()
+    steady = ds[1:] if len(ds) > 1 else ds  # first step carries the jit
+    for d in steady:
+        registry.observe(f"{run}/step_time_s", d)
+    out: Dict[str, Any] = dict(steps=len(ds))
+    if steady:
+        s = sorted(steady)
+        out["step_time_p50"] = percentile(s, 0.50)
+        out["step_time_p99"] = percentile(s, 0.99)
+    wall = tracer.run_wall_s()
+    if wall and ds:
+        out["goodput"] = min(sum(ds) / wall, 1.0)
+        registry.gauge(f"{run}/goodput", out["goodput"])
+    spec = getattr(ff, "machine_spec", None)
+    step_s = out.get("step_time_p50")
+    if spec is not None and step_s:
+        n_chips = int(ff.mesh.devices.size)
+        flops = train_step_flops(ff)
+        out["model_flops_per_step"] = flops
+        out["mfu"] = flops / n_chips / step_s / float(spec.flops)
+        registry.gauge(f"{run}/mfu", out["mfu"])
+    return out

@@ -105,19 +105,61 @@ def predicted_comm_time(ff, census: Dict[str, Dict[str, float]]
     report stays a complete account of what the step moves."""
     n_chips = int(ff.mesh.devices.size)
     spec = ff.machine_spec
+    corr = getattr(spec, "collective_corrections", None) or {}
     per_kind: Dict[str, Dict[str, float]] = {}
     total = 0.0
     for kind, entry in (census or {}).items():
         t = spec.collective_time(kind, entry["bytes"], n_chips)
-        per_kind[kind] = dict(entry, predicted_s=t)
+        row = dict(entry, predicted_s=t)
+        # when a measured correction is already applied to this spec,
+        # also record the raw analytic time: the per-kind drift ratio
+        # must be measured / UNCALIBRATED so re-ingesting a corrected
+        # run derives the same absolute factor (replace converges)
+        # instead of the residual ~1.0 (which would un-calibrate it)
+        f = corr.get(kind)
+        if f:
+            row["predicted_uncorrected_s"] = t / f
+        per_kind[kind] = row
         total += t
     return dict(comm_s=total, per_kind=per_kind)
+
+
+def collective_drift(per_kind_predicted: Dict[str, Dict[str, Any]],
+                     measured_collectives: Dict[str, Dict[str, float]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Join measured per-collective device time (obs/devtrace.py
+    attribution, ``{kind: {per_step_s, ...}}``) against the simulator-
+    priced census (``predicted_comm_time``'s per-kind rows). Each kind
+    gets ``measured_s`` / ``predicted_s`` / ``ratio`` — the per-kind
+    correction signal ``scripts/calibrate.py --ingest-drift`` folds into
+    CALIBRATION.json ``collective_corrections`` (the measured hook the
+    machine model's wus_rs/ag_time terms calibrate against).
+
+    ``ratio`` is measured / UNCORRECTED-analytic
+    (``predicted_uncorrected_s`` when the pricing spec already carried a
+    correction, else ``predicted_s``): the derived factor is absolute,
+    so re-ingesting a run priced with corrections applied replaces the
+    stored factor with the same value instead of its ~1.0 residual."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for kind in sorted(set(per_kind_predicted) | set(measured_collectives)):
+        prow = per_kind_predicted.get(kind) or {}
+        pred = prow.get("predicted_s")
+        base = prow.get("predicted_uncorrected_s", pred)
+        meas = (measured_collectives.get(kind) or {}).get("per_step_s")
+        row: Dict[str, Any] = dict(predicted_s=pred, measured_s=meas)
+        if base and meas and base > 0:
+            row["ratio"] = meas / base
+        out[kind] = row
+    return out
 
 
 def drift_report(ff, measured_step_s: Optional[float],
                  census: Optional[Dict[str, Dict[str, float]]] = None,
                  measured: Optional[Dict[str, float]] = None,
-                 phase_summary: Optional[Dict[str, Any]] = None
+                 phase_summary: Optional[Dict[str, Any]] = None,
+                 measured_collectives: Optional[
+                     Dict[str, Dict[str, float]]] = None,
+                 step_metrics: Optional[Dict[str, Any]] = None
                  ) -> Dict[str, Any]:
     """The calibration report: predicted-vs-measured step-time ratio.
 
@@ -127,6 +169,14 @@ def drift_report(ff, measured_step_s: Optional[float],
     prediction (``search_info["predicted_time"]``) when one exists, so
     drift of the REAL search — not just this reconstruction — is
     visible.
+
+    ``measured_collectives``: per-kind measured device time from the
+    device-trace attribution (``{kind: {per_step_s, ...}}``); when
+    present the report gains a ``collective_drift`` section joining it
+    against the census-priced prediction. ``step_metrics``: the
+    goodput/MFU/step-percentile dict from
+    ``obs.devtrace.record_step_metrics``, carried along for the run
+    report.
     """
     pred = predicted_step_time(ff, measured=measured)
     comm = predicted_comm_time(ff, census or {})
@@ -155,4 +205,9 @@ def drift_report(ff, measured_step_s: Optional[float],
     )
     if phase_summary:
         report["phases"] = phase_summary
+    if measured_collectives is not None:
+        report["collective_drift"] = collective_drift(
+            comm["per_kind"], measured_collectives)
+    if step_metrics:
+        report["step_metrics"] = step_metrics
     return report

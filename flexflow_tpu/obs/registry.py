@@ -5,22 +5,46 @@ steps, graph sizes); ``export()`` snapshots the registry as a
 version-stamped JSON artifact. Deliberately tiny — dict bumps on paths
 that already pay a jit dispatch, nothing that could show up in a
 benchmark profile.
+
+``observe()`` additionally keeps a bounded reservoir of samples per
+series so p50/p99 survive into the snapshot without unbounded memory:
+a week-long traced run's step-time distribution costs at most
+``RESERVOIR_SIZE`` floats, and the reservoir is a uniform sample of the
+whole stream (classic algorithm-R with a fixed seed, so snapshots are
+reproducible for a given observation sequence).
 """
 
 from __future__ import annotations
 
+import random
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+RESERVOIR_SIZE = 512
+
+
+def percentile(sorted_samples: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted sample list — the
+    ONE percentile definition shared by the registry snapshot, the
+    traced-run step metrics (obs/devtrace.py) and bench.py, so a p50
+    can never mean two different things depending on which artifact a
+    report read it from."""
+    n = len(sorted_samples)
+    rank = max(1, -(-int(q * 100) * n // 100))  # ceil(q*n) via int math
+    return sorted_samples[min(rank, n) - 1]
 
 
 class CounterRegistry:
-    """Monotonic counters + last-value gauges + min/max/sum observations."""
+    """Monotonic counters + last-value gauges + observation summaries
+    (count/sum/min/max plus reservoir-sampled p50/p99)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._observations: Dict[str, Dict[str, float]] = {}
+        self._samples: Dict[str, List[float]] = {}
+        self._rng = random.Random(0xFF5EED)
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -31,18 +55,27 @@ class CounterRegistry:
             self._gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
-        """Streaming count/sum/min/max summary (no per-sample storage)."""
+        """Streaming count/sum/min/max summary plus a bounded reservoir
+        (RESERVOIR_SIZE samples max) for percentile estimates."""
         v = float(value)
         with self._lock:
             o = self._observations.get(name)
             if o is None:
                 self._observations[name] = dict(count=1.0, sum=v, min=v,
                                                 max=v)
+                self._samples[name] = [v]
+                return
+            o["count"] += 1.0
+            o["sum"] += v
+            o["min"] = min(o["min"], v)
+            o["max"] = max(o["max"], v)
+            s = self._samples[name]
+            if len(s) < RESERVOIR_SIZE:
+                s.append(v)
             else:
-                o["count"] += 1.0
-                o["sum"] += v
-                o["min"] = min(o["min"], v)
-                o["max"] = max(o["max"], v)
+                j = self._rng.randrange(int(o["count"]))
+                if j < RESERVOIR_SIZE:
+                    s[j] = v
 
     def get(self, name: str, default: float = 0.0) -> float:
         with self._lock:
@@ -52,11 +85,18 @@ class CounterRegistry:
 
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
+            obs: Dict[str, Dict[str, float]] = {}
+            for k, v in self._observations.items():
+                e = dict(v)
+                s = sorted(self._samples.get(k, ()))
+                if s:
+                    e["p50"] = percentile(s, 0.50)
+                    e["p99"] = percentile(s, 0.99)
+                obs[k] = e
             return dict(
                 counters=dict(self._counters),
                 gauges=dict(self._gauges),
-                observations={k: dict(v)
-                              for k, v in self._observations.items()},
+                observations=obs,
             )
 
     def reset(self) -> None:
@@ -64,6 +104,7 @@ class CounterRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._observations.clear()
+            self._samples.clear()
 
     def export(self, path: str, host_id: Optional[int] = None) -> str:
         from flexflow_tpu.obs.artifacts import write_artifact

@@ -50,7 +50,13 @@ class NullTracer:
     def set_meta(self, **meta):
         pass
 
+    def add_trace_events(self, events, threads=None):
+        pass
+
     def step_time_s(self):
+        return None
+
+    def run_wall_s(self):
         return None
 
     def export(self):
@@ -58,6 +64,22 @@ class NullTracer:
 
 
 NULL_TRACER = NullTracer()
+
+
+def _clock_pair(samples: int = 5):
+    """A (perf_counter, unix-wall) pair sampled with minimal skew: each
+    wall read is bracketed by two perf_counter reads and the tightest
+    bracket wins. The pair is the shared epoch ``merge_host_traces``
+    uses to line up per-host lanes, so its uncertainty (the bracket
+    width) is stamped into the trace header."""
+    best = None
+    for _ in range(samples):
+        p0 = time.perf_counter()
+        w = time.time()
+        p1 = time.perf_counter()
+        if best is None or (p1 - p0) < best[2]:
+            best = ((p0 + p1) / 2, w, p1 - p0)
+    return best
 
 
 class _Span:
@@ -122,8 +144,12 @@ class StepTracer:
         self._dropped = 0
         self.meta: Dict[str, Any] = {}
         self._events: List[Dict[str, Any]] = []
-        self._origin = time.perf_counter()
-        self._wall_origin = time.time()
+        self._extra_events: List[Dict[str, Any]] = []
+        self._extra_threads: Dict[int, str] = {}
+        # shared wall-clock epoch: a tight (perf_counter, unix) pairing
+        # so merge_host_traces can shift every host onto one timeline
+        self._origin, self._wall_origin, pair_spread = _clock_pair()
+        self._clock_pair_spread_us = pair_spread * 1e6
         self._step_index = -1
         self._in_step = False
         os.makedirs(trace_dir, exist_ok=True)
@@ -167,6 +193,19 @@ class StepTracer:
     def set_meta(self, **meta) -> None:
         self.meta.update(meta)
 
+    def add_trace_events(self, events: List[Dict[str, Any]],
+                         threads: Optional[Dict[int, str]] = None) -> None:
+        """Attach externally-sourced Chrome-trace events (the devtrace
+        capture's device lanes + attribution counter tracks) to this
+        host's export. ``events`` are complete Chrome dicts except
+        ``pid`` (stamped at export with this host's pid); ``threads``
+        maps each lane tid to its Perfetto row label. Extra events land
+        in the ``.trace.json`` only — the ``.events.jsonl`` stream stays
+        the host-phase record (device spans have their own
+        ``.devtrace.json`` artifact)."""
+        self._extra_events.extend(events)
+        self._extra_threads.update(threads or {})
+
     # ---- summaries --------------------------------------------------------
     def step_durations_s(self) -> List[float]:
         return [e["dur"] / 1e6 for e in self._events if e["name"] == "step"
@@ -182,6 +221,15 @@ class StepTracer:
             ds = ds[1:]
         ds = sorted(ds)
         return ds[len(ds) // 2]
+
+    def run_wall_s(self) -> Optional[float]:
+        """Wall span the recorded events cover (first event start to
+        last event end) — the denominator of the goodput gauge."""
+        spans = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                 for e in self._events]
+        if not spans:
+            return None
+        return (max(e for _, e in spans) - min(s for s, _ in spans)) / 1e6
 
     def phase_summary(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
@@ -206,7 +254,10 @@ class StepTracer:
         """Write the Chrome-trace JSON + JSONL stream; returns paths."""
         header = artifact_header(host_id=self.host_id, kind="trace")
         header.update(run_name=self.run_name, run_seq=self.run_seq,
-                      wall_origin_unix=self._wall_origin, **self.meta)
+                      wall_origin_unix=self._wall_origin,
+                      clock_pair_spread_us=round(
+                          self._clock_pair_spread_us, 3),
+                      **self.meta)
         if self._dropped:
             header["dropped_events"] = self._dropped
         trace_events = [
@@ -215,6 +266,10 @@ class StepTracer:
             dict(name="thread_name", ph="M", pid=self.host_id, tid=0,
                  args=dict(name="train_loop")),
         ]
+        for tid, label in sorted(self._extra_threads.items()):
+            trace_events.append(dict(name="thread_name", ph="M",
+                                     pid=self.host_id, tid=tid,
+                                     args=dict(name=label)))
         for e in self._events:
             ev = dict(name=e["name"], pid=self.host_id, tid=0,
                       ts=round(e["ts"], 3), cat="flexflow_tpu")
@@ -228,6 +283,8 @@ class StepTracer:
             if args:
                 ev["args"] = args
             trace_events.append(ev)
+        for ev in self._extra_events:  # devtrace lanes, pre-rebased
+            trace_events.append(dict(ev, pid=self.host_id))
         trace_path = os.path.join(self.trace_dir,
                                   self.file_stem + ".trace.json")
         atomic_write_text(trace_path, json.dumps(
@@ -286,27 +343,41 @@ def merge_host_traces(trace_dir: str,
     t0 = min((o for o in origins if o is not None), default=None)
     events: List[Dict[str, Any]] = []
     hosts: List[int] = []
-    # One thread row per source trace, keyed (run_name, run_seq): a dir
-    # holding repeated fits, evaluate legs, or stale traces from an
-    # earlier run merges into distinct rows instead of interleaving
-    # overlapping spans on one (pid, tid).
-    threads: Dict[Any, str] = {}  # (pid, tid) -> label
+    # One BLOCK of thread rows per source trace, keyed (run_name,
+    # run_seq): a dir holding repeated fits, evaluate legs, or stale
+    # traces from an earlier run merges into distinct row groups instead
+    # of interleaving overlapping spans on one (pid, tid). Within a
+    # block, each of the source trace's own tids (train_loop = 0 plus
+    # any devtrace lanes) keeps its own row.
+    BLOCK = 256  # > any per-trace tid (train_loop 0, devtrace lanes <128)
+    blocks: Dict[Any, str] = {}  # (pid, block) -> label
+    rows: Dict[Any, str] = {}  # (pid, out_tid) -> row label
     for data, origin in zip(loaded, origins):
         meta = data.get("metadata") or {}
         hid = meta.get("host_id")
         pid = int(hid) if hid is not None else 0
         run = str(meta.get("run_name", "run"))
-        tid = int(meta.get("run_seq", 0))
-        label = f"{run}_r{tid:02d}"
-        while threads.get((pid, tid), label) != label:
-            tid += 1  # same (host, seq) from different runs: next row
-        threads[(pid, tid)] = label
+        block = int(meta.get("run_seq", 0))
+        label = f"{run}_r{block:02d}"
+        while blocks.get((pid, block), label) != label:
+            block += 1  # same (host, seq) from different runs: next block
+        blocks[(pid, block)] = label
+        lane_names: Dict[int, str] = {}
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                lane_names[int(ev.get("tid", 0))] = str(
+                    (ev.get("args") or {}).get("name", ""))
         shift_us = ((origin - t0) * 1e6
                     if origin is not None and t0 is not None else 0.0)
         for ev in data.get("traceEvents", []):
             if ev.get("ph") == "M":
                 continue  # per-file metadata is re-synthesized below
-            ev = dict(ev, pid=pid, tid=tid)
+            tid = int(ev.get("tid", 0)) % BLOCK
+            out_tid = block * BLOCK + tid
+            lane = lane_names.get(tid)
+            rows[(pid, out_tid)] = (label if tid == 0 or not lane
+                                    else f"{label}:{lane}")
+            ev = dict(ev, pid=pid, tid=out_tid)
             if shift_us and "ts" in ev:
                 ev["ts"] = round(ev["ts"] + shift_us, 3)
             events.append(ev)
@@ -315,10 +386,10 @@ def merge_host_traces(trace_dir: str,
     if not events:
         return None
     meta_events: List[Dict[str, Any]] = []
-    for pid in sorted({p for p, _ in threads}):
+    for pid in sorted({p for p, _ in rows}):
         meta_events.append(dict(name="process_name", ph="M", pid=pid,
                                 tid=0, args=dict(name=f"host{pid}")))
-    for (pid, tid), label in sorted(threads.items()):
+    for (pid, tid), label in sorted(rows.items()):
         meta_events.append(dict(name="thread_name", ph="M", pid=pid,
                                 tid=tid, args=dict(name=label)))
     events = meta_events + events

@@ -226,6 +226,46 @@ def derive_op_corrections(reports) -> dict:
     return out
 
 
+def derive_collective_corrections(reports) -> dict:
+    """Per-collective-kind correction factors from drift reports that
+    carry a ``collective_drift`` section (runs traced with
+    ``--profile-steps``: measured per-kind device time from the
+    devtrace attribution vs the census-priced machine-model predictions).
+
+    The factor is measured/predicted per kind, weighted across reports
+    by each kind's share of the report's predicted comm time — a kind
+    that dominates a run's priced comms anchors its own factor, a
+    nanosecond scalar reduction barely moves it. Keyed PLATFORM first
+    (like ``derive_op_corrections``): drift measured on the CPU thunk
+    executor must never calibrate the chip's ICI terms. These land in
+    CALIBRATION.json ``collective_corrections`` — the measured hook for
+    the machine model's per-kind collective costs (ROADMAP chip item
+    (a): calibrate ``wus_rs/ag_time`` against measured RS/AG)."""
+    num: dict = {}  # (platform, kind) -> share-weighted ratio sum
+    den: dict = {}
+    for rep in reports:
+        cd = rep.get("collective_drift") or {}
+        rows = {k: r for k, r in cd.items()
+                if r.get("ratio") and r.get("predicted_s")}
+        total_pred = sum(float(r["predicted_s"]) for r in rows.values())
+        if total_pred <= 0:
+            continue
+        platform = (rep.get("header") or {}).get("platform") or "unknown"
+        for kind, r in rows.items():
+            share = float(r["predicted_s"]) / total_pred
+            num[(platform, kind)] = (num.get((platform, kind), 0.0)
+                                     + share * float(r["ratio"]))
+            den[(platform, kind)] = den.get((platform, kind), 0.0) + share
+    out: dict = {}
+    for (platform, kind) in sorted(num):
+        if den[(platform, kind)] <= 0:
+            continue
+        out.setdefault(platform, {})[kind] = dict(
+            factor=round(num[(platform, kind)] / den[(platform, kind)], 4),
+            weight=round(den[(platform, kind)], 4))
+    return out
+
+
 def ingest_drift(trace_dir: str) -> int:
     """Fold ``*.drift.json`` obs artifacts into CALIBRATION.json.
 
@@ -309,11 +349,23 @@ def ingest_drift(trace_dir: str) -> int:
             for t, e in bucket.items():
                 print(f"  correction [{platform}] {t:24s} "
                       f"x{e['factor']:.4f} (weight {e['weight']:.3f})")
+    coll = derive_collective_corrections(reports)
+    n_coll = 0
+    if coll:
+        merged = cal.setdefault("collective_corrections", {})
+        for platform, bucket in coll.items():
+            merged.setdefault(platform, {}).update(bucket)
+            n_coll += len(bucket)
+            for kind, e in bucket.items():
+                print(f"  collective [{platform}] {kind:24s} "
+                      f"x{e['factor']:.4f} (weight {e['weight']:.3f})")
     with open(cal_path, "w") as f:
         json.dump(cal, f, indent=1)
     print(f"ingested {len(rows)} drift report(s) into {cal_path}"
           + (f"; {n_corr} op-type correction(s) -> "
-             f"search/profile.py measured tables" if n_corr else ""))
+             f"search/profile.py measured tables" if n_corr else "")
+          + (f"; {n_coll} per-collective correction(s) -> "
+             f"machine.collective_time calibration" if n_coll else ""))
     return 0
 
 

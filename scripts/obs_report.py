@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Render a run report (JSON + markdown) from an obs trace dir.
+
+Consumes the artifacts the observability subsystem writes next to a
+traced run — ``*.counters.json`` (step-time histograms, goodput/MFU
+gauges), ``*.devtrace.json`` (per-step device compute/comms/exposed
+attribution), ``*.drift.json`` (predicted-vs-measured step time and
+per-collective drift), ``*.summary.json`` (census + HBM peak) — and
+rolls them up per run into one ``OBS_REPORT.json`` plus an optional
+markdown table. Deliberately stdlib-only and read-only: it must run in
+CI against whatever artifacts a test session left behind (or none —
+an empty/missing dir produces an empty report and exit 0, so the
+tier-1 obs stage is non-fatal by construction).
+
+Usage: python scripts/obs_report.py TRACE_DIR [--out PATH] [--md PATH]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+SUFFIXES = ("counters", "devtrace", "drift", "summary")
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def collect_runs(trace_dir):
+    """Group the dir's JSON artifacts by run stem
+    (``fit_r00_host00`` -> {counters: ..., devtrace: ..., ...})."""
+    runs = {}
+    for suffix in SUFFIXES:
+        for path in sorted(glob.glob(
+                os.path.join(trace_dir, f"*.{suffix}.json"))):
+            stem = os.path.basename(path)[:-len(f".{suffix}.json")]
+            data = _load(path)
+            if data is not None:
+                runs.setdefault(stem, {})[suffix] = data
+    return runs
+
+
+def _round(v, nd=6):
+    return round(v, nd) if isinstance(v, (int, float)) else v
+
+
+def summarize_run(stem, arts):
+    """One report row per run stem, from whichever artifacts exist."""
+    drift = arts.get("drift") or {}
+    devtrace = arts.get("devtrace") or {}
+    counters = arts.get("counters") or {}
+    summary = arts.get("summary") or {}
+    header = (drift.get("header") or devtrace.get("header")
+              or counters.get("header") or summary.get("header") or {})
+    m = re.match(r"(.+)_r\d+_host\d+$", stem)
+    run_name = header.get("run_name") or (m.group(1) if m else stem)
+    row = dict(run=stem, run_name=run_name,
+               platform=header.get("platform"),
+               version=header.get("flexflow_tpu_version"))
+    # step-time distribution: registry reservoir percentiles first,
+    # drift's step_metrics as fallback
+    obs = (counters.get("observations") or {}).get(
+        f"{run_name}/step_time_s") or {}
+    metrics = drift.get("step_metrics") or {}
+    p50 = obs.get("p50", metrics.get("step_time_p50"))
+    p99 = obs.get("p99", metrics.get("step_time_p99"))
+    if p50 is not None:
+        row["step_time_p50_s"] = _round(p50)
+    if p99 is not None:
+        row["step_time_p99_s"] = _round(p99)
+    gauges = counters.get("gauges") or {}
+    for key in ("goodput", "mfu"):
+        v = gauges.get(f"{run_name}/{key}", metrics.get(key))
+        if v is not None:
+            row[key] = _round(v, 8)
+    if devtrace:
+        tot = devtrace.get("totals") or {}
+        n = devtrace.get("steps") or 0
+        dt = dict(steps=n, window=devtrace.get("window"))
+        for k in ("compute_s", "comms_s", "overlapped_comms_s",
+                  "exposed_comms_s", "wall_s"):
+            if k in tot:
+                dt[k] = _round(tot[k])
+        if n and tot.get("wall_s"):
+            dt["exposed_comms_frac"] = _round(
+                tot.get("exposed_comms_s", 0.0) / tot["wall_s"], 4)
+        dt["collectives"] = {
+            k: dict(per_step_s=_round(e.get("per_step_s")),
+                    count=e.get("count"))
+            for k, e in (devtrace.get("collectives") or {}).items()}
+        row["devtrace"] = dt
+    if drift:
+        row["drift_ratio"] = _round(drift.get("ratio"), 4)
+        cd = drift.get("collective_drift")
+        if cd:
+            row["collective_drift"] = {
+                k: dict(predicted_s=_round(e.get("predicted_s"), 9),
+                        measured_s=_round(e.get("measured_s"), 9),
+                        ratio=_round(e.get("ratio"), 4))
+                for k, e in cd.items()}
+    if summary:
+        mem = summary.get("memory") or {}
+        if mem.get("peak_bytes"):
+            row["hbm_peak_bytes"] = mem["peak_bytes"]
+        tot = summary.get("collectives_total") or {}
+        if tot:
+            row["collective_bytes"] = tot.get("bytes")
+    return row
+
+
+def build_report(trace_dir):
+    runs = collect_runs(trace_dir)
+    rows = [summarize_run(stem, arts)
+            for stem, arts in sorted(runs.items())]
+    report = dict(trace_dir=os.path.abspath(trace_dir),
+                  generated_unix=time.time(),
+                  runs=rows)
+    merged = os.path.join(trace_dir, "merged.trace.json")
+    if os.path.exists(merged):
+        report["merged_trace"] = merged
+    if not rows:
+        report["note"] = ("no obs artifacts found — run with --trace-dir "
+                          "(and --profile-steps for device attribution)")
+    return report
+
+
+def _fmt(v, scale=1.0, nd=3):
+    return "-" if v is None else f"{v * scale:.{nd}f}"
+
+
+def to_markdown(report):
+    lines = ["# Observability run report", "",
+             f"Trace dir: `{report['trace_dir']}`", ""]
+    if not report["runs"]:
+        lines.append("_" + report.get("note", "no runs") + "_")
+        return "\n".join(lines) + "\n"
+    lines += ["| run | p50 step ms | p99 step ms | goodput | MFU | "
+              "compute ms/step | exposed comms ms/step | drift ratio |",
+              "|---|---|---|---|---|---|---|---|"]
+    for r in report["runs"]:
+        dt = r.get("devtrace") or {}
+        n = dt.get("steps") or 0
+        lines.append(
+            "| {run} | {p50} | {p99} | {gp} | {mfu} | {comp} | {exp} | "
+            "{ratio} |".format(
+                run=r["run"],
+                p50=_fmt(r.get("step_time_p50_s"), 1e3),
+                p99=_fmt(r.get("step_time_p99_s"), 1e3),
+                gp=_fmt(r.get("goodput")),
+                mfu=_fmt(r.get("mfu"), nd=6),
+                comp=_fmt(dt.get("compute_s", 0.0) / n * 1e3
+                          if n else None),
+                exp=_fmt(dt.get("exposed_comms_s", 0.0) / n * 1e3
+                         if n else None),
+                ratio=_fmt(r.get("drift_ratio"))))
+    drifts = [(r["run"], k, e) for r in report["runs"]
+              for k, e in (r.get("collective_drift") or {}).items()]
+    if drifts:
+        lines += ["", "## Measured vs priced collectives", "",
+                  "| run | kind | predicted s | measured s | ratio |",
+                  "|---|---|---|---|---|"]
+        for run, kind, e in drifts:
+            lines.append(f"| {run} | {kind} | "
+                         f"{_fmt(e.get('predicted_s'), nd=9)} | "
+                         f"{_fmt(e.get('measured_s'), nd=9)} | "
+                         f"{_fmt(e.get('ratio'))} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv):
+    opts = {}
+    args = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--out", "--md"):
+            i += 1
+            if i >= len(argv):
+                print(f"obs_report.py: {a} expects a path", file=sys.stderr)
+                return 2
+            opts[a] = argv[i]
+        else:
+            args.append(a)
+        i += 1
+    if len(args) != 1:
+        print("usage: obs_report.py TRACE_DIR [--out PATH] [--md PATH]",
+              file=sys.stderr)
+        return 2
+
+    trace_dir = args[0]
+    out = opts.get("--out") or os.path.join(trace_dir, "OBS_REPORT.json")
+    report = build_report(trace_dir)
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    md = opts.get("--md")
+    if md:
+        with open(md, "w") as f:
+            f.write(to_markdown(report))
+    print(f"obs report: {len(report['runs'])} run(s) -> {out}"
+          + (f" + {md}" if md else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -102,7 +102,8 @@ def build_model(name, batch, layout, on_cpu, image_size=None):
 
 def step_throughput(ff, xs, y, iters, windows):
     from bench import time_train
-    return time_train(ff, xs, y, iters=iters, windows=windows)
+    sps, _ = time_train(ff, xs, y, iters=iters, windows=windows)
+    return sps
 
 
 def main():

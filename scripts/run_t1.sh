@@ -10,10 +10,24 @@
 # Lint ERRORs fail the gate only when the tests themselves passed, so a
 # test regression is never masked by a lint exit code.
 #
+# An obs stage then renders OBS_REPORT.json from the tier-1 trace dir:
+# FFS_T1_TRACE_DIR points the devtrace smoke test (tests/test_devtrace.py)
+# at a stable location, and scripts/obs_report.py rolls whatever
+# artifacts landed there into a run report. Non-fatal by construction —
+# an empty dir (profiling test skipped/failed) produces an empty report.
+#
 # Usage: scripts/run_t1.sh      (run from anywhere; cd's to the repo root)
 cd "$(dirname "$0")/.." || exit 2
+# fresh default trace dir per gate run; a user-supplied dir is left
+# intact (it may hold chip captures) — new runs append distinct stems
+if [ -z "${FFS_T1_TRACE_DIR:-}" ]; then
+  export FFS_T1_TRACE_DIR=/tmp/_t1_trace
+  rm -rf "$FFS_T1_TRACE_DIR"
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c);
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then echo "FFLINT: exit $lint_rc (see FFLINT.json / /tmp/_t1_lint.err)"; else echo "FFLINT: clean (FFLINT.json)"; fi
+timeout -k 10 120 python scripts/obs_report.py "$FFS_T1_TRACE_DIR" --out OBS_REPORT.json > /dev/null 2> /tmp/_t1_obs.err; obs_rc=$?
+if [ "$obs_rc" -ne 0 ]; then echo "OBS: report failed (exit $obs_rc, see /tmp/_t1_obs.err) — non-fatal"; else echo "OBS: report written (OBS_REPORT.json)"; fi
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
 exit $rc

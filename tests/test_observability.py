@@ -322,7 +322,8 @@ class TestCounterRegistry:
         assert d["counters"]["a"] == 3
         assert d["gauges"]["g"] == 7.5
         assert d["observations"]["o"] == dict(count=2.0, sum=4.0,
-                                              min=1.0, max=3.0)
+                                              min=1.0, max=3.0,
+                                              p50=1.0, p99=3.0)
         assert r.get("a") == 3
         r.reset()
         assert r.to_dict()["counters"] == {}
