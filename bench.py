@@ -41,7 +41,7 @@ def single_device_mesh_on_cpu(on_cpu):
     return make_mesh(1, {"data": 1})
 
 
-def time_train(ff, xs, y, iters, windows, tracer=None):
+def time_train(ff, xs, y, iters, windows, tracer=None, capture=None):
     """Steady-state training samples/s: jitted fwd+bwd+update loop.
 
     Plain per-step dispatch, NOT lax.scan — measured r3 (30 iters, v5e):
@@ -57,6 +57,12 @@ def time_train(ff, xs, y, iters, windows, tracer=None):
     window's host fetch is the only sync. None (the default) leaves the
     loop untouched.
 
+    ``capture`` (an obs DeviceTraceCapture) wraps the WARMUP steps only
+    — the windowed profiler session runs on post-compile warmup steps
+    (window "1:3"), so the device-time attribution (exposed_comms_frac,
+    the overlap direction's coordinate) is measured without perturbing
+    the throughput windows.
+
     Returns ``(samples_per_s, step_samples)`` where ``step_samples`` are
     the per-step dispatch intervals (perf_counter deltas) of every
     measured window — in the steady state the async pipeline backs up on
@@ -64,6 +70,7 @@ def time_train(ff, xs, y, iters, windows, tracer=None):
     main() reports their p50/p99 next to the throughput number
     (informational, no ratchet).
     """
+    import jax
     import jax.random as jrandom
 
     train_step = ff.executor.make_train_step()
@@ -87,9 +94,15 @@ def time_train(ff, xs, y, iters, windows, tracer=None):
     params, opt_state, state = ff.params, ff.opt_state, ff.state
     rng = jrandom.PRNGKey(0)
     # warmup (compile; a second round catches the donation-aliased recompile)
-    for _ in range(3):
-        params, opt_state, state, rng, loss = step(params, opt_state,
-                                                   state, rng)
+    for i in range(3):
+        if capture is not None:
+            with capture.step(i):
+                params, opt_state, state, rng, loss = step(
+                    params, opt_state, state, rng)
+                jax.block_until_ready(loss)  # device spans inside window
+        else:
+            params, opt_state, state, rng, loss = step(params, opt_state,
+                                                       state, rng)
     float(loss)
     bs = ff.input_tensors[0].shape[0]
     best_dt = None
@@ -495,11 +508,25 @@ def main():
         tracer = None
         try:
             ff, xs, y, cfg_dict = build(on_cpu)
+            capture = None
             if trace_dir:
-                from flexflow_tpu.obs import make_tracer
+                from flexflow_tpu.obs import make_capture, make_tracer
                 tracer = make_tracer(trace_dir, run_name=name)
+                # windowed device capture over the post-compile warmup
+                # steps: exposed_comms_frac (the overlap direction's
+                # ratchet coordinate) without perturbing the measurement
+                if tracer.active:
+                    capture = make_capture(tracer, "1:3")
             sps, step_samples = time_train(ff, xs, y, iters=iters,
-                                           windows=windows, tracer=tracer)
+                                           windows=windows, tracer=tracer,
+                                           capture=capture)
+            devrep = None
+            if capture is not None and capture.active:
+                try:
+                    devrep = capture.finalize(ff, tracer)
+                except Exception as e:
+                    print(f"[obs] {name}: devtrace attribution failed: "
+                          f"{e!r}", file=sys.stderr)
             summary = None
             if tracer is not None and tracer.active:
                 summary = emit_obs_artifacts(name, ff, tracer)
@@ -549,10 +576,19 @@ def main():
             wl["step_time_p99"] = round(p99, 6)
         if mfu is not None:
             wl["mfu"] = round(mfu, 8)
+        # measured exposed-comms fraction from the warmup-window device
+        # capture (ISSUE 8 satellite): the coordinate the comms-compute
+        # overlap direction ratchets — informational, recorded per
+        # workload into bench_history for cross-round comparison
+        tot = (devrep or {}).get("totals") or {}
+        if tot.get("wall_s"):
+            wl["exposed_comms_frac"] = round(
+                tot.get("exposed_comms_s", 0.0) / tot["wall_s"], 4)
         ent = hist.get(key)
         if isinstance(ent, dict):
             ent.update({k: wl[k] for k in
-                        ("step_time_p50", "step_time_p99", "mfu")
+                        ("step_time_p50", "step_time_p99", "mfu",
+                         "exposed_comms_frac")
                         if k in wl})
         if name == "bert_proxy":
             result.update({

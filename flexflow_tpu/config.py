@@ -68,6 +68,13 @@ class FFConfig:
     # (reference: measure_operator_cost, src/runtime/model.cu:38-74)
     search_measure_ops: bool = False
     measured_cache_file: Optional[str] = None
+    # structured search-trace emission (search provenance, ISSUE 8): the
+    # native core records per-mesh candidates with rejection reasons, the
+    # frontier-DP evolution, and a per-op candidate-choice cost table.
+    # Lands in search_info["search_trace"] (and, when a trace dir is
+    # active, the <run>.searchtrace.json obs artifact). Off by default:
+    # tracing re-runs the per-mesh DP, roughly doubling search cost.
+    search_trace: bool = False
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -211,6 +218,8 @@ class FFConfig:
                 self.enable_substitution = False
             elif a == "--search-measure-ops":
                 self.search_measure_ops = True
+            elif a == "--search-trace":
+                self.search_trace = True
             elif a == "--measured-cache":
                 self.measured_cache_file = take()
             elif a == "--memory-search":

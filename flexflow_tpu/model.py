@@ -898,12 +898,36 @@ class FFModel:
             step_metrics = record_step_metrics(self, tracer)
         except Exception as e:
             print(f"[obs] step metrics failed: {e!r}", file=sys.stderr)
+        if success:
+            # predicted-schedule lanes (obs/simtrace): replay the
+            # strategy through the native simulator and inject the
+            # sim:compute / sim:comms Perfetto lanes BEFORE export so
+            # the predicted step sits next to the measured device lanes
+            try:
+                from flexflow_tpu.obs import write_simtrace
+                write_simtrace(self, tracer)
+            except Exception as e:
+                print(f"[obs] simulated-schedule trace failed: {e!r}",
+                      file=sys.stderr)
         try:
             tracer.export()
         except Exception as e:
             print(f"[obs] trace export failed: {e!r}", file=sys.stderr)
         stem = os.path.join(tracer.trace_dir, tracer.file_stem)
         extra = dict(run_name=tracer.run_name, run_seq=tracer.run_seq)
+        if (isinstance(self.search_info, dict)
+                and self.search_info.get("search_trace")):
+            # search provenance (--search-trace): the native trace rides
+            # along as its own artifact so calibrate/explain tooling can
+            # consume it without re-running the search
+            try:
+                write_artifact(stem + ".searchtrace.json",
+                               dict(self.search_info["search_trace"]),
+                               host_id=tracer.host_id, kind="searchtrace",
+                               header_extra=extra)
+            except Exception as e:
+                print(f"[obs] search-trace artifact failed: {e!r}",
+                      file=sys.stderr)
         if success:
             summary = None
             try:
@@ -963,7 +987,12 @@ class FFModel:
             mtotals = None
             for b in range(num_batches):
                 step_idx += 1
-                with tracer.step(), devtrace.step(step_idx):
+                # devtrace OUTSIDE tracer.step: the profiler session
+                # start/stop at the window edges costs whole seconds on
+                # some backends — observability overhead, not step time,
+                # so it must not land in the step span the percentile
+                # reservoir observes (ISSUE 8 satellite: the 17 s p99)
+                with devtrace.step(step_idx), tracer.step():
                     inputs, labels = next_batch(epoch, b)
                     self._rng, sub = jax.random.split(self._rng)
                     with tracer.phase("dispatch"):

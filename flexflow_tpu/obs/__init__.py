@@ -38,6 +38,12 @@ from flexflow_tpu.obs.inspect import (
     model_context,
 )
 from flexflow_tpu.obs.registry import CounterRegistry, get_registry
+from flexflow_tpu.obs.simtrace import (
+    corpus_rows,
+    sim_lane_events,
+    simtrace_report,
+    write_simtrace,
+)
 from flexflow_tpu.obs.roofline import (
     class_aggregates,
     finish_aggregates,
@@ -70,6 +76,10 @@ __all__ = [
     "model_context",
     "CounterRegistry",
     "get_registry",
+    "corpus_rows",
+    "sim_lane_events",
+    "simtrace_report",
+    "write_simtrace",
     "class_aggregates",
     "finish_aggregates",
     "format_markdown",

@@ -576,10 +576,18 @@ def record_step_metrics(ff, tracer, registry=None) -> Dict[str, Any]:
         registry = get_registry()
     run = tracer.run_name
     ds = tracer.step_durations_s()
-    steady = ds[1:] if len(ds) > 1 else ds  # first step carries the jit
+    # step 0 carries the jit compile: record it SEPARATELY and never let
+    # it into the percentile reservoir — a single-step run used to
+    # observe its compile step, which is how OBS_REPORT once showed a
+    # 17 s p99 against an 18 ms p50 (ISSUE 8 satellite). With one step
+    # there is no steady-state sample, so nothing is observed.
+    steady = ds[1:]
+    out: Dict[str, Any] = dict(steps=len(ds))
+    if ds:
+        out["compile_time_s"] = ds[0]
+        registry.gauge(f"{run}/compile_time_s", ds[0])
     for d in steady:
         registry.observe(f"{run}/step_time_s", d)
-    out: Dict[str, Any] = dict(steps=len(ds))
     if steady:
         s = sorted(steady)
         out["step_time_p50"] = percentile(s, 0.50)

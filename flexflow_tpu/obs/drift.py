@@ -125,7 +125,8 @@ def predicted_comm_time(ff, census: Dict[str, Dict[str, float]]
 
 
 def collective_drift(per_kind_predicted: Dict[str, Dict[str, Any]],
-                     measured_collectives: Dict[str, Dict[str, float]]
+                     measured_collectives: Dict[str, Dict[str, float]],
+                     platform: Optional[str] = None
                      ) -> Dict[str, Dict[str, Any]]:
     """Join measured per-collective device time (obs/devtrace.py
     attribution, ``{kind: {per_step_s, ...}}``) against the simulator-
@@ -139,7 +140,14 @@ def collective_drift(per_kind_predicted: Dict[str, Dict[str, Any]],
     (``predicted_uncorrected_s`` when the pricing spec already carried a
     correction, else ``predicted_s``): the derived factor is absolute,
     so re-ingesting a run priced with corrections applied replaces the
-    stored factor with the same value instead of its ~1.0 residual."""
+    stored factor with the same value instead of its ~1.0 residual.
+
+    ``platform`` (when known) stamps each row ``ingestable``: a drift
+    ratio measured on the CPU thunk executor compares host-CPU wall time
+    against analytic ICI pricing — 400-600x "drift" that is backend
+    mismatch, not calibration signal — so CPU-platform rows are marked
+    ``ingestable: false`` and ``calibrate.py --ingest-drift`` skips
+    them instead of deriving corrections (ISSUE 8 satellite)."""
     out: Dict[str, Dict[str, Any]] = {}
     for kind in sorted(set(per_kind_predicted) | set(measured_collectives)):
         prow = per_kind_predicted.get(kind) or {}
@@ -149,6 +157,8 @@ def collective_drift(per_kind_predicted: Dict[str, Dict[str, Any]],
         row: Dict[str, Any] = dict(predicted_s=pred, measured_s=meas)
         if base and meas and base > 0:
             row["ratio"] = meas / base
+        if platform is not None:
+            row["ingestable"] = platform != "cpu"
         out[kind] = row
     return out
 
@@ -206,8 +216,13 @@ def drift_report(ff, measured_step_s: Optional[float],
     if phase_summary:
         report["phases"] = phase_summary
     if measured_collectives is not None:
+        try:
+            import jax
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = None
         report["collective_drift"] = collective_drift(
-            comm["per_kind"], measured_collectives)
+            comm["per_kind"], measured_collectives, platform=platform)
     if step_metrics:
         report["step_metrics"] = step_metrics
     return report

@@ -1,0 +1,198 @@
+"""Simulated-schedule observability: the search's predicted timeline.
+
+The native simulator already produces a full task schedule for the
+strategy it ranked best — per-task ``start``/``finish`` seconds on the
+{compute, ICI} streams (``ffs_sim.hpp`` list scheduler, returned by
+``ffs_simulate``). Until now that schedule existed only inside the cost
+model; this module renders it as Perfetto lanes (``sim:compute`` /
+``sim:comms``) on the SAME lane layout as the measured device lanes the
+devtrace capture injects (``device:compute`` / ``device:comms``,
+obs/devtrace.py), so the predicted and the measured step sit side by
+side in one merged timeline — the SCALE-Sim-style simulator validation
+view (PAPERS.md): if the simulator believes the right schedule, the two
+lane groups should look alike; where they diverge is exactly the
+calibration signal.
+
+Also emits the ``.simtrace.json`` artifact: the predicted step
+breakdown plus per-op priced rows joined against measured per-op
+seconds where a profile table exists — the (op class x shape x sharding
+-> priced terms, measured seconds) corpus rows the learned-TPU-cost-
+model direction trains on ("A Learned Performance Model for TPUs",
+PAPERS.md 2008.01040).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# Perfetto lane tids for the predicted schedule, disjoint from the
+# devtrace lanes (64-66) and below the merge tid-block size (256), so
+# sim lanes keep their own rows in both per-host and merged traces.
+SIM_TID_COMPUTE, SIM_TID_COMMS = 72, 73
+SIM_LANE_THREADS = {SIM_TID_COMPUTE: "sim:compute",
+                    SIM_TID_COMMS: "sim:comms"}
+
+# SimTask kind -> lane (mirrors the simulator's two-stream scheduler:
+# comm/gradsync ride the ICI stream, everything else the compute
+# stream). Public: explain.py's timeline rendering uses the same map.
+SIM_COMMS_KINDS = ("comm", "gradsync")
+
+
+def sim_lane_events(tasks: List[Dict[str, Any]],
+                    name_of: Dict[int, str],
+                    t0_us: float = 0.0) -> List[Dict[str, Any]]:
+    """Chrome-trace ``X`` events for a simulated task schedule.
+
+    ``tasks``: ``ffs_simulate`` response rows ({kind, node, start,
+    finish, collective?, bytes?}, seconds). Zero-duration rows (the
+    census records pipe simulation emits) are skipped — they carry
+    bytes, not time. ``name_of`` maps node INDEX -> op name. ``t0_us``
+    places the schedule on the host timeline (e.g. at a measured step's
+    start) so predicted and measured lanes share a clock base."""
+    events: List[Dict[str, Any]] = []
+    for t in tasks:
+        start = float(t.get("start", 0.0))
+        finish = float(t.get("finish", 0.0))
+        if finish <= start:
+            continue
+        kind = str(t.get("kind", ""))
+        tid = SIM_TID_COMMS if kind in SIM_COMMS_KINDS else SIM_TID_COMPUTE
+        node = t.get("node", -1)
+        label = name_of.get(node, "step")
+        args: Dict[str, Any] = dict(kind=kind)
+        if t.get("collective"):
+            args["collective"] = t["collective"]
+            args["bytes"] = t.get("bytes", 0)
+        events.append(dict(
+            name=f"{label}:{kind}", ph="X", tid=tid,
+            ts=round(t0_us + start * 1e6, 3),
+            dur=round((finish - start) * 1e6, 3),
+            cat="simtrace", args=args))
+    return events
+
+
+def per_op_predicted(tasks: List[Dict[str, Any]]
+                     ) -> Dict[int, Dict[str, float]]:
+    """Node index -> priced seconds per term, aggregated from the
+    simulated schedule (fwd_s / bwd_s / comm_s / gradsync_s). Collective
+    census bytes accumulate under ``collective_bytes``."""
+    out: Dict[int, Dict[str, float]] = {}
+    for t in tasks:
+        node = t.get("node", -1)
+        if node is None or node < 0:
+            continue
+        row = out.setdefault(int(node), dict(
+            fwd_s=0.0, bwd_s=0.0, comm_s=0.0, gradsync_s=0.0,
+            collective_bytes=0.0))
+        dur = max(0.0, float(t.get("finish", 0.0))
+                  - float(t.get("start", 0.0)))
+        kind = str(t.get("kind", ""))
+        if kind in ("fwd", "bwd"):
+            row[f"{kind}_s"] += dur
+        elif kind == "comm":
+            row["comm_s"] += dur
+        elif kind == "gradsync":
+            row["gradsync_s"] += dur
+        if t.get("collective"):
+            row["collective_bytes"] += float(t.get("bytes", 0.0))
+    return out
+
+
+def corpus_rows(ff, resp: Dict[str, Any],
+                measured: Optional[Dict[str, float]] = None
+                ) -> List[Dict[str, Any]]:
+    """Learned-cost-model corpus rows: one per op, joining the op's
+    identity (class, shape, sharding choice) -> the simulator's priced
+    terms -> measured per-op seconds where a profile table has them
+    (``ff.op_profile`` from ``--profiling`` / ``--search-measure-ops``,
+    or an explicit ``measured`` table). ``measured.source`` records
+    whether the measured half is real ("measured") or absent (None) so
+    a training-set builder can filter."""
+    from flexflow_tpu.obs.drift import work_division
+
+    measured = measured if measured is not None else (ff.op_profile or {})
+    priced = per_op_predicted(resp.get("tasks") or [])
+    rows: List[Dict[str, Any]] = []
+    for idx, node in enumerate(ff.executor.nodes):
+        op = node.op
+        st = (ff.strategy or {}).get(op.guid)
+        p = priced.get(idx, dict(fwd_s=0.0, bwd_s=0.0, comm_s=0.0,
+                                 gradsync_s=0.0, collective_bytes=0.0))
+        mf = measured.get(f"{op.guid}:fwd")
+        mb = measured.get(f"{op.guid}:bwd")
+        rows.append(dict(
+            guid=op.guid,
+            name=op.name,
+            type=op.op_type.name,
+            out_shape=list(op.output_shapes[0]) if op.output_shapes else [],
+            choice=getattr(st, "choice", None),
+            # priced terms are PER-CHIP SHARDED schedule durations;
+            # measured fwd/bwd are WHOLE-OP unsharded profile seconds —
+            # work_div is the strategy's split so consumers can compare
+            # measured/work_div against priced fwd+bwd (compute only)
+            work_div=work_division(node, ff.mesh),
+            priced=dict(p),
+            measured=dict(
+                fwd_s=mf, bwd_s=mb,
+                source="measured" if mf is not None else None),
+        ))
+    return rows
+
+
+def simtrace_report(ff, resp: Dict[str, Any],
+                    measured: Optional[Dict[str, float]] = None
+                    ) -> Dict[str, Any]:
+    """The ``.simtrace.json`` payload: predicted step breakdown + the
+    per-op corpus rows + the mesh the prediction assumed."""
+    return dict(
+        predicted=dict(
+            step_s=resp.get("iteration_time"),
+            fwd_s=resp.get("fwd_time"),
+            bwd_s=resp.get("bwd_time"),
+            comm_s=resp.get("comm_time"),
+            gradsync_s=resp.get("gradsync_time"),
+            memory_bytes=resp.get("memory"),
+        ),
+        search_predicted_s=(ff.search_info or {}).get("predicted_time")
+        if isinstance(ff.search_info, dict) else None,
+        mesh_axes=dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape)),
+        tasks=sum(1 for t in (resp.get("tasks") or [])
+                  if float(t.get("finish", 0.0))
+                  > float(t.get("start", 0.0))),
+        per_op=corpus_rows(ff, resp, measured=measured),
+    )
+
+
+def write_simtrace(ff, tracer, align_ts_us: Optional[float] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """Replay the compiled strategy through the native simulator, write
+    the ``.simtrace.json`` artifact, and inject the predicted schedule
+    as ``sim:`` Perfetto lanes into the tracer's export (must run BEFORE
+    ``tracer.export()``).
+
+    ``align_ts_us``: where on the tracer timeline the simulated step
+    begins. Defaults to the start of the LAST traced step (steady state
+    — never the compile-carrying first step) so the predicted lanes
+    overlay a measured step in the merged view. Returns the simtrace
+    report, or None when the tracer is inactive."""
+    if not getattr(tracer, "active", False):
+        return None
+    from flexflow_tpu.obs.artifacts import write_artifact
+    from flexflow_tpu.search.validate import simulate_strategy
+    import os
+
+    resp = simulate_strategy(ff)
+    report = simtrace_report(ff, resp)
+    if align_ts_us is None:
+        align_ts_us = tracer.last_step_start_us() or 0.0
+    name_of = {i: n.op.name for i, n in enumerate(ff.executor.nodes)}
+    events = sim_lane_events(resp.get("tasks") or [], name_of,
+                             t0_us=align_ts_us)
+    if events:
+        tracer.add_trace_events(events, dict(SIM_LANE_THREADS))
+    stem = os.path.join(tracer.trace_dir, tracer.file_stem)
+    write_artifact(stem + ".simtrace.json", report,
+                   host_id=tracer.host_id, kind="simtrace",
+                   header_extra=dict(run_name=tracer.run_name,
+                                     run_seq=tracer.run_seq))
+    return report

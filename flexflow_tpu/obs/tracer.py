@@ -56,6 +56,9 @@ class NullTracer:
     def step_time_s(self):
         return None
 
+    def last_step_start_us(self):
+        return None
+
     def run_wall_s(self):
         return None
 
@@ -221,6 +224,15 @@ class StepTracer:
             ds = ds[1:]
         ds = sorted(ds)
         return ds[len(ds) // 2]
+
+    def last_step_start_us(self) -> Optional[float]:
+        """Timeline timestamp (µs, tracer origin) where the LAST traced
+        step began — the steady-state anchor the simtrace lanes align
+        to (never the compile-carrying first step when more than one
+        step ran)."""
+        starts = [e["ts"] for e in self._events
+                  if e["name"] == "step" and not e.get("instant")]
+        return starts[-1] if starts else None
 
     def run_wall_s(self) -> Optional[float]:
         """Wall span the recorded events cover (first event start to

@@ -307,6 +307,11 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # (ffs_strategy.hpp); "off" removes them
             weight_update_sharding=getattr(config, "weight_update_sharding",
                                            "auto"),
+            # search provenance: per-mesh candidates + rejection reasons,
+            # frontier-DP evolution, per-op candidate cost table
+            # (--search-trace / FFS_SEARCH_TRACE; explain.py sets it too)
+            emit_search_trace=bool(getattr(config, "search_trace", False)
+                                   or os.environ.get("FFS_SEARCH_TRACE")),
         ),
         measured=measured or {},
     )
@@ -354,6 +359,8 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 memory_correction=mem_correction,
                 stats=resp.get("stats", {}),
                 rewrites=resp.get("rewrites", []))
+    if resp.get("search_trace"):
+        info["search_trace"] = resp["search_trace"]
     if resp.get("pipeline") and mesh_axes.get("pipe", 1) > 1:
         # the search picked a GPipe strategy: hand compile() what the
         # lowering onto pipeline_spmd needs (rewrites never fire together

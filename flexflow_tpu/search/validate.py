@@ -122,9 +122,13 @@ def predicted_vs_actual_memory(ff) -> Dict[str, float]:
                 ratio=actual / float(predicted))
 
 
-def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
-    """Collective kind -> summed bytes the native simulator charged for
-    the strategy FFModel.compile selected."""
+def simulate_strategy(ff) -> Dict[str, Any]:
+    """Replay the strategy FFModel.compile selected through the native
+    simulator; returns the FULL response — iteration_time / memory /
+    fwd/bwd/comm/gradsync breakdown plus the scheduled task list
+    (per-task start/finish seconds and collective census records). The
+    task schedule is what ``obs/simtrace.py`` renders as the predicted
+    Perfetto timeline next to the measured device lanes."""
     from flexflow_tpu.search.native import native_simulate
     from flexflow_tpu.search.unity import machine_to_json, serialize_graph
 
@@ -172,7 +176,13 @@ def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
             microbatches=int(ex.microbatches),
             schedule=ex.schedule,
             shard_queue=bool(ex.shard_queue))
-    resp = native_simulate(req)
+    return native_simulate(req)
+
+
+def priced_collectives(ff, min_bytes: float = 1 << 12) -> Dict[str, float]:
+    """Collective kind -> summed bytes the native simulator charged for
+    the strategy FFModel.compile selected."""
+    resp = simulate_strategy(ff)
     out: Dict[str, float] = defaultdict(float)
     for t in resp.get("tasks", []):
         if t.get("collective") and t.get("bytes", 0) >= min_bytes:

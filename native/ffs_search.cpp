@@ -68,6 +68,9 @@ struct SearchConfig {
                                     // (reference --disable-fusion)
   bool enable_wus = true;           // weight-update-sharding choice variants
                                     // (--weight-update-sharding != off)
+  bool emit_trace = false;          // structured search-trace emission
+                                    // (search provenance; explain.py /
+                                    // obs .searchtrace.json artifact)
   std::map<std::string, std::vector<std::string>> allowed;  // op type -> choice names
 
   static SearchConfig from_json(const Json& j) {
@@ -98,6 +101,7 @@ struct SearchConfig {
     // "auto"/"on" enumerate the _wus twins (the DP picks per mesh);
     // "off" removes the dimension entirely
     c.enable_wus = j.get("weight_update_sharding").as_string() != "off";
+    c.emit_trace = j.get("emit_search_trace").as_bool(false);
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
       for (const Json& a : r.get("allow").items()) names.push_back(a.as_string());
@@ -174,10 +178,14 @@ struct DPState {
   }
 };
 
+// `evo` (optional): per-node frontier-evolution rows for the search
+// trace — how many states each layer expanded, how many survived the
+// spec-key dedup (a duplicate key losing on cost = "dominated"), the
+// alpha cut and the beam. One row per node keeps the trace O(N).
 DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& choices,
                      const MeshShape& mesh, const MachineModel& m,
                      const SearchConfig& cfg, double lambda,
-                     const MeasuredCosts* measured) {
+                     const MeasuredCosts* measured, Json* evo = nullptr) {
   const size_t N = g.nodes.size();
   // remaining-use counts per (guid, out_idx)
   std::map<std::pair<int64_t, int>, int> uses;
@@ -299,12 +307,29 @@ DPResult frontier_dp(const Graph& g, const std::vector<std::vector<Choice>>& cho
     for (auto& kv : next)
       if (kv.second.cost <= alpha_cut || next.size() <= 4)
         pruned.push_back(std::move(kv.second));
+    size_t kept_alpha = pruned.size();
     if ((int)pruned.size() > beam) {
       std::nth_element(pruned.begin(), pruned.begin() + beam, pruned.end(),
                        [](const DPState& a, const DPState& b) {
                          return a.cost < b.cost;
                        });
       pruned.resize(beam);
+    }
+    if (evo != nullptr) {
+      size_t expanded = states.size() * choices[i].size();
+      Json row = Json::object();
+      row.set("node", Json(n.guid));
+      row.set("name", Json(n.name));
+      row.set("choices", Json((int64_t)choices[i].size()));
+      row.set("states_in", Json((int64_t)states.size()));
+      row.set("expanded", Json((int64_t)expanded));
+      row.set("unique_frontiers", Json((int64_t)next.size()));
+      row.set("pruned_dominated", Json((int64_t)(expanded - next.size())));
+      row.set("pruned_alpha", Json((int64_t)(next.size() - kept_alpha)));
+      row.set("pruned_beam", Json((int64_t)(kept_alpha - pruned.size())));
+      row.set("kept", Json((int64_t)pruned.size()));
+      row.set("best_cost", Json(best_cost));
+      evo->push_back(std::move(row));
     }
     states = std::move(pruned);
     live = std::move(next_live);
@@ -426,9 +451,16 @@ PipelineMeta pipeline_meta_from_json(const Json& j) {
 // Outer mesh-shape enumeration (MachineView enumeration analog) — N-D:
 // every (data, model, seq, expert[, pipe]) factorization of the chip count
 // legal for this graph's seq extent / expert count / repeated-block count.
-std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
-                                        const SearchConfig& cfg,
-                                        const PipelineMeta& pipe = {}) {
+// `rejects` (optional, search trace): factorizations of the chip count
+// that failed a legality gate, with the gate's reason — the "illegal"
+// rejection class of the search trace. Every firing is recorded (one
+// per rejected factorization); build_search_trace aggregates them into
+// one row per gate with a count, so the emitted trace stays bounded
+// even at chip counts with thousands of factorizations.
+std::vector<MeshShape> enumerate_meshes(
+    const Graph& g, const MachineModel& m, const SearchConfig& cfg,
+    const PipelineMeta& pipe = {},
+    std::vector<std::pair<MeshShape, std::string>>* rejects = nullptr) {
   int64_t seq_extent = 0;
   int64_t num_experts = 0;
   // explicit REPARTITION ops pin an axis's extent: the Python applier
@@ -463,20 +495,35 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
   };
   std::vector<MeshShape> meshes;
   int N = std::max(1, m.num_devices);
+  auto reject = [&](const MeshShape& mesh, const char* why) {
+    if (rejects != nullptr) rejects->push_back({mesh, why});
+  };
   for (int mp = 1; mp <= N; ++mp) {
     if (N % mp) continue;
-    if (mp > 1 && (cfg.only_data_parallel || !cfg.enable_parameter_parallel))
+    if (mp > 1 && (cfg.only_data_parallel || !cfg.enable_parameter_parallel)) {
+      reject({N / mp, mp, 1, 1, 1}, "parameter_parallel_disabled");
       continue;
+    }
     for (int sp = 1; mp * sp <= N; ++sp) {
       if ((N / mp) % sp) continue;
       if (sp > 1 && (cfg.only_data_parallel || seq_extent % sp ||
-                     seq_extent <= 1))
+                     seq_extent <= 1)) {
+        reject({N / mp / sp, mp, sp, 1, 1},
+               cfg.only_data_parallel ? "only_data_parallel"
+               : seq_extent <= 1      ? "no_seq_dim"
+                                      : "seq_extent_indivisible");
         continue;
+      }
       for (int ep = 1; mp * sp * ep <= N; ++ep) {
         if ((N / mp / sp) % ep) continue;
         if (ep > 1 && (cfg.only_data_parallel || num_experts % ep ||
-                       num_experts <= 1))
+                       num_experts <= 1)) {
+          reject({N / mp / sp / ep, mp, sp, ep, 1},
+                 cfg.only_data_parallel ? "only_data_parallel"
+                 : num_experts <= 1     ? "no_expert_ops"
+                                        : "experts_indivisible");
           continue;
+        }
         int rem = N / mp / sp / ep;
         // pipe axis: only on repeated-block graphs, composed with dp only
         // (the pipeline lowering runs stages under shard_map over
@@ -486,22 +533,36 @@ std::vector<MeshShape> enumerate_meshes(const Graph& g, const MachineModel& m,
           if (pp > 1 &&
               (cfg.only_data_parallel || !cfg.enable_pipeline_parallel ||
                !pipe.present || pipe.num_blocks % pp ||
-               mp * sp * ep != 1))
+               mp * sp * ep != 1)) {
+            reject({rem / pp, mp, sp, ep, pp},
+                   !cfg.enable_pipeline_parallel ? "pipeline_disabled"
+                   : cfg.only_data_parallel      ? "only_data_parallel"
+                   : !pipe.present               ? "no_repeated_blocks"
+                   : mp * sp * ep != 1 ? "pipe_composes_with_dp_only"
+                                       : "blocks_indivisible_by_stages");
             continue;
+          }
           int dp = rem / pp;
           // the host stages the batch sharded over 'data': dp must divide
           // it (under pipe: each microbatch shards over dp too)
-          if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) continue;
-          if (!axis_ok(kData, dp) || !axis_ok(kModel, mp) ||
-              !axis_ok(kSeq, sp) || !axis_ok(kExpert, ep))
+          if (cfg.batch > 0 && dp > 1 && cfg.batch % dp) {
+            reject({dp, mp, sp, ep, pp}, "batch_indivisible_by_dp");
             continue;
+          }
+          if (!axis_ok(kData, dp) || !axis_ok(kModel, mp) ||
+              !axis_ok(kSeq, sp) || !axis_ok(kExpert, ep)) {
+            reject({dp, mp, sp, ep, pp}, "pinned_axis_extent_mismatch");
+            continue;
+          }
           // multislice: model/seq/expert collectives are latency-bound and
           // must stay inside one ICI domain; only the data (gradient) axis
           // and the point-to-point pipe hops may cross slices
           if (m.num_slices > 1) {
             int inner = mp * sp * ep;
-            if (inner > m.chips_per_slice() || m.chips_per_slice() % inner)
+            if (inner > m.chips_per_slice() || m.chips_per_slice() % inner) {
+              reject({dp, mp, sp, ep, pp}, "inner_axes_cross_slice");
               continue;
+            }
           }
           meshes.push_back({dp, mp, sp, ep, pp});
         }
@@ -636,6 +697,307 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
     }
   }
   return ev;
+}
+
+// ---- search trace (provenance) --------------------------------------------
+//
+// A versioned, structured record of WHAT the search considered and WHY it
+// rejected what it rejected (ISSUE 8): per-mesh candidate rows with
+// rejection reasons (illegal / infeasible / over_budget / dominated), the
+// frontier-DP evolution on the winning mesh, and a per-op candidate-choice
+// cost table with each choice's cost decomposed into compute / collective /
+// memory / opt-state terms plus the collectives it implies. Emission is
+// opt-in (config.emit_search_trace) — the trace re-runs the per-mesh DP
+// once, roughly doubling search cost, which an explain/trace run accepts.
+
+constexpr int64_t kSearchTraceVersion = 1;
+
+Json mesh_to_json(const MeshShape& mesh) {
+  Json j = Json::object();
+  j.set("data", Json((int64_t)mesh.dp));
+  j.set("model", Json((int64_t)mesh.mp));
+  j.set("seq", Json((int64_t)mesh.sp));
+  j.set("expert", Json((int64_t)mesh.ep));
+  j.set("pipe", Json((int64_t)mesh.pp));
+  return j;
+}
+
+bool mesh_eq(const MeshShape& a, const MeshShape& b) {
+  return a.dp == b.dp && a.mp == b.mp && a.sp == b.sp && a.ep == b.ep &&
+         a.pp == b.pp;
+}
+
+// The collectives a choice statically implies (kind, global bytes, ring
+// size, cause) — the "what would this cost on the wire" column of the
+// explain table, mirroring the census records the simulators emit.
+Json choice_collectives_json(const Choice& c, bool training) {
+  Json arr = Json::array();
+  auto add = [&](const char* kind, double bytes, int k, const char* why) {
+    Json o = Json::object();
+    o.set("kind", Json(std::string(kind)));
+    o.set("bytes", Json(bytes));
+    o.set("ring", Json((int64_t)k));
+    o.set("cause", Json(std::string(why)));
+    arr.push_back(std::move(o));
+  };
+  if (c.psum_bytes > 0 && c.psum_k > 1)
+    add("allreduce", c.psum_bytes, c.psum_k, "partial_sum");
+  if (training && c.bwd_psum_bytes > 0 && c.psum_k > 1)
+    add("allreduce", c.bwd_psum_bytes, c.psum_k, "backward_partial_sum");
+  if (c.wgather_bytes > 0 && c.psum_k > 1)
+    add("allgather", c.wgather_bytes, c.psum_k, "tiny_batch_weight_gather");
+  if (c.gather_bytes > 0 && c.gather_k > 1)
+    add("allgather", c.gather_bytes, c.gather_k, "combine_boundary");
+  if (c.ring_bytes > 0 && c.ring_k > 1)
+    add("ppermute", c.ring_bytes, c.ring_k, "ring_attention_rotation");
+  if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1) {
+    if (c.wus) {
+      add("allreduce", c.gradsync_bytes, c.gradsync_k,
+          "grad_reduce_scatter");
+      add("allgather", c.gradsync_bytes, c.gradsync_k,
+          "wus_param_allgather");
+    } else {
+      add("allreduce", c.gradsync_bytes, c.gradsync_k, "grad_allreduce");
+    }
+  }
+  return arr;
+}
+
+// One candidate-choice row: priced terms decomposed the way the frontier
+// DP sees them. compute = fwd+bwd roofline; collective = per-op comms +
+// gradient sync; opt_state = the update-triad HBM time WUS divides by the
+// ring; memory = param / opt-state / activation bytes per device.
+Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
+                       const MachineModel& m, const SearchConfig& cfg,
+                       const MeasuredCosts* measured, bool chosen) {
+  NodeCost full = node_cost(n, c, mesh, m, cfg.training, measured,
+                            cfg.opt_state_factor);
+  NodeCost base = node_cost(n, c, mesh, m, cfg.training, measured);
+  double update_s = full.gradsync - base.gradsync;
+  double param_b = detail::sharded_param_bytes(n, c, mesh);
+  double pmem = node_param_memory(n, c, mesh, cfg.opt_state_factor);
+  Json cj = Json::object();
+  cj.set("choice", Json(c.name));
+  cj.set("chosen", Json(chosen));
+  cj.set("work_div", Json(c.work_div));
+  Json terms = Json::object();
+  terms.set("fwd_s", Json(base.fwd));
+  terms.set("bwd_s", Json(base.bwd));
+  terms.set("compute_s", Json(base.fwd + base.bwd));
+  terms.set("comm_s", Json(base.comm));
+  terms.set("gradsync_s", Json(base.gradsync));
+  terms.set("collective_s", Json(base.comm + base.gradsync));
+  terms.set("opt_state_s", Json(update_s));
+  terms.set("total_s", Json(full.total()));
+  cj.set("terms", terms);
+  Json mem = Json::object();
+  mem.set("param_bytes", Json(param_b));
+  mem.set("opt_state_bytes", Json(std::max(0.0, pmem - param_b)));
+  mem.set("act_bytes", Json(node_act_bytes(n, c, mesh)));
+  cj.set("memory", mem);
+  cj.set("collectives", choice_collectives_json(c, cfg.training));
+  return cj;
+}
+
+// Per-op candidate table for an (assignment, mesh): every enumerated
+// choice priced, the winner flagged — the rows scripts/explain.py turns
+// into the chosen-vs-runner-up table, and (joined against measured per-op
+// seconds) the learned-cost-model training corpus.
+Json per_op_trace(const Graph& g,
+                  const std::vector<std::vector<Choice>>& choices,
+                  const Assignment& assign, const MeshShape& mesh,
+                  const MachineModel& m, const SearchConfig& cfg,
+                  const MeasuredCosts* measured) {
+  Json ops = Json::array();
+  for (size_t i = 0; i < g.nodes.size(); ++i) {
+    const Node& n = g.nodes[i];
+    Json oj = Json::object();
+    oj.set("guid", Json(n.guid));
+    oj.set("name", Json(n.name));
+    oj.set("type", Json(n.type));
+    oj.set("flops", Json(n.fwd_flops));
+    oj.set("param_bytes", Json((double)n.param_bytes()));
+    Json shp = Json::array();
+    if (!n.output_shapes.empty())
+      for (int64_t d : n.output_shapes[0]) shp.push_back(Json(d));
+    oj.set("out_shape", shp);
+    oj.set("chosen", Json(choices[i][assign[i]].name));
+    Json cands = Json::array();
+    for (size_t ci = 0; ci < choices[i].size(); ++ci)
+      cands.push_back(choice_trace_json(n, choices[i][ci], mesh, m, cfg,
+                                        measured, ci == (size_t)assign[i]));
+    oj.set("candidates", cands);
+    ops.push_back(std::move(oj));
+  }
+  return ops;
+}
+
+// The whole trace: mesh candidates (including illegal factorizations and
+// their gate), per-mesh DP outcome vs the winner, the winning mesh's
+// frontier-DP evolution, and the winner's per-op candidate table.
+Json build_search_trace(const Graph& g, const MachineModel& m,
+                        const SearchConfig& cfg, double threshold,
+                        const MeasuredCosts& measured, const GraphEval& best,
+                        const PipelineMeta& pipe, bool graph_rewritten) {
+  Json tr = Json::object();
+  tr.set("schema_version", Json(kSearchTraceVersion));
+  tr.set("graph", Json(std::string(graph_rewritten ? "rewritten"
+                                                   : "original")));
+  Json cfgj = Json::object();
+  cfgj.set("budget", Json((int64_t)cfg.budget));
+  cfgj.set("alpha", Json(cfg.alpha));
+  cfgj.set("training", Json(cfg.training));
+  cfgj.set("opt_state_factor", Json(cfg.opt_state_factor));
+  cfgj.set("memory_threshold", Json(threshold));
+  tr.set("config", cfgj);
+
+  Json mrows = Json::array();
+  std::vector<std::pair<MeshShape, std::string>> illegal;
+  auto meshes = enumerate_meshes(g, m, cfg, pipe, &illegal);
+  // one row per legality gate: the first rejected factorization as the
+  // representative mesh plus a firing count — a 4096-chip machine has
+  // thousands of rejected factorizations and the trace must not carry
+  // one row each
+  std::map<std::string, std::pair<MeshShape, int64_t>> by_gate;
+  for (const auto& rej : illegal) {
+    auto it = by_gate.find(rej.second);
+    if (it == by_gate.end()) by_gate[rej.second] = {rej.first, 1};
+    else it->second.second++;
+  }
+  for (const auto& kv : by_gate) {
+    Json row = Json::object();
+    row.set("mesh", mesh_to_json(kv.second.first));
+    row.set("status", Json(std::string("illegal")));
+    row.set("reason", Json(kv.first));
+    row.set("count", Json(kv.second.second));
+    mrows.push_back(std::move(row));
+  }
+  for (const MeshShape& mesh : meshes) {
+    MachineModel mt = m;
+    mt.assign_torus(mesh.dp, mesh.mp, mesh.sp, mesh.ep);
+    Json row = Json::object();
+    row.set("mesh", mesh_to_json(mesh));
+    auto choices = all_choices(g, mesh, cfg);
+    DPResult dp = mesh.pp > 1
+        ? frontier_dp(g, choices, mesh, mt, cfg, 0.0, &measured)
+        : dp_with_memory(g, choices, mesh, mt, cfg, threshold, &measured);
+    row.set("dp_states", Json(dp.states));
+    if (!dp.ok) {
+      row.set("status", Json(std::string("infeasible")));
+      row.set("reason", Json(std::string(
+          threshold > 0 ? "no_assignment_fits_memory_threshold"
+                        : "no_feasible_assignment")));
+      mrows.push_back(std::move(row));
+      continue;
+    }
+    std::vector<Choice> cs;
+    for (size_t i = 0; i < dp.assign.size(); ++i)
+      cs.push_back(choices[i][dp.assign[i]]);
+    if (mesh.pp > 1) {
+      // pipe wrapper: every (microbatch count, schedule) candidate is a
+      // priced sub-row; the mesh row carries the best of them
+      int kblocks = pipe.num_blocks / mesh.pp;
+      std::vector<bool> scheds;
+      if (cfg.pipeline_schedule == "gpipe") scheds = {false};
+      else if (cfg.pipeline_schedule == "circular") scheds = {true};
+      else { scheds = {false}; if (kblocks > 1) scheds.push_back(true); }
+      Json cand = Json::array();
+      double best_t = 1e30;
+      bool any_fit = false, any = false;
+      for (int M : microbatch_candidates(cfg, pipe, mesh)) {
+        if (M < 1) continue;
+        int64_t b = cfg.batch > 0 ? cfg.batch : pipe.batch;
+        if (b > 0 && (b % ((int64_t)M * std::max(1, mesh.dp)))) continue;
+        for (bool circ : scheds) {
+          if (circ && kblocks > 1 && M < mesh.pp) continue;
+          SimResult sr = simulate_pipeline(
+              g, mt, mesh, cs, pipe, cfg.training, cfg.opt_state_factor,
+              &measured, M, circ, cfg.pipeline_shard_queue);
+          any = true;
+          Json pc = Json::object();
+          pc.set("microbatches", Json((int64_t)M));
+          pc.set("schedule", Json(std::string(circ ? "circular" : "gpipe")));
+          pc.set("time_s", Json(sr.iteration_time));
+          pc.set("memory_bytes", Json(sr.memory));
+          bool fits = !(threshold > 0 && sr.memory > threshold);
+          pc.set("fits_memory", Json(fits));
+          cand.push_back(std::move(pc));
+          if (fits) {
+            any_fit = true;
+            best_t = std::min(best_t, sr.iteration_time);
+          }
+        }
+      }
+      row.set("pipeline_candidates", cand);
+      if (!any || !any_fit) {
+        row.set("status", Json(std::string(any ? "over_budget"
+                                               : "infeasible")));
+        row.set("reason", Json(std::string(
+            any ? "all_microbatch_candidates_exceed_memory"
+                : "no_legal_microbatch_count")));
+        mrows.push_back(std::move(row));
+        continue;
+      }
+      // the winner row reports the time the search actually committed
+      // to (MCMC refinement may have improved on the DP assignment this
+      // re-run reproduces) — keeps winner.time <= every dominated time
+      bool won = mesh_eq(mesh, best.mesh);
+      row.set("time_s", Json(won ? best.time : best_t));
+      row.set("status", Json(std::string(won ? "winner" : "dominated")));
+      if (!won)
+        row.set("reason", Json(std::string("slower_than_winner")));
+      mrows.push_back(std::move(row));
+      continue;
+    }
+    TaskgraphSimulator sim(g, mt, mesh, cfg.training, cfg.overlap,
+                           cfg.opt_state_factor, &measured);
+    // the winner row reports the assignment the search COMMITTED to
+    // (MCMC refinement may have improved on the DP assignment this
+    // re-run reproduces; winner.time <= every dominated DP time holds
+    // because refinement only ever lowers a mesh's time)
+    bool won = mesh_eq(mesh, best.mesh);
+    SimResult sr = won ? best.sim : sim.simulate(cs);
+    row.set("time_s", Json(sr.iteration_time));
+    row.set("memory_bytes", Json(sr.memory));
+    Json bd = Json::object();
+    bd.set("fwd_s", Json(sr.fwd_time));
+    bd.set("bwd_s", Json(sr.bwd_time));
+    bd.set("comm_s", Json(sr.comm_time));
+    bd.set("gradsync_s", Json(sr.gradsync_time));
+    row.set("sim_breakdown", bd);
+    if (threshold > 0 && sr.memory > threshold) {
+      row.set("status", Json(std::string("over_budget")));
+      row.set("reason", Json(std::string("simulated_memory_exceeds_threshold")));
+    } else if (won) {
+      row.set("status", Json(std::string("winner")));
+    } else {
+      row.set("status", Json(std::string("dominated")));
+      row.set("reason", Json(std::string("slower_than_winner")));
+    }
+    mrows.push_back(std::move(row));
+  }
+  tr.set("meshes", mrows);
+
+  // frontier-DP evolution + per-op candidate table on the winning mesh
+  // (evolution re-recorded at lambda = 0 — the memory-lambda refinement
+  // reruns the same recursion with a nonzero price on bytes)
+  if (best.ok) {
+    MachineModel mt = m;
+    mt.assign_torus(best.mesh.dp, best.mesh.mp, best.mesh.sp, best.mesh.ep);
+    Json evo = Json::array();
+    frontier_dp(g, best.choices, best.mesh, mt, cfg, 0.0, &measured, &evo);
+    tr.set("dp_evolution", evo);
+    tr.set("winner_mesh", mesh_to_json(best.mesh));
+    if (best.mesh.pp > 1) {
+      Json pj = Json::object();
+      pj.set("microbatches", Json((int64_t)best.pipe_microbatches));
+      pj.set("schedule", Json(best.pipe_schedule));
+      tr.set("winner_pipeline", pj);
+    }
+    tr.set("ops", per_op_trace(g, best.choices, best.assign, best.mesh, mt,
+                               cfg, &measured));
+  }
+  return tr;
 }
 
 // ---- driver ---------------------------------------------------------------
@@ -870,6 +1232,21 @@ Json optimize(const Json& req) {
   stats.set("comm_time", Json(best.sim.comm_time));
   stats.set("gradsync_time", Json(best.sim.gradsync_time));
   out.set("stats", stats);
+  if (cfg.emit_trace && best.ok) {
+    // provenance, not the product: a trace failure must never void the
+    // strategy the search already found
+    try {
+      out.set("search_trace",
+              build_search_trace(best_g, m, cfg, threshold, measured, best,
+                                 best_trace.empty() ? pipe : PipelineMeta{},
+                                 !best_trace.empty()));
+    } catch (const std::exception& e) {
+      Json err = Json::object();
+      err.set("schema_version", Json(kSearchTraceVersion));
+      err.set("error", Json(std::string(e.what())));
+      out.set("search_trace", err);
+    }
+  }
   return out;
 }
 

@@ -240,22 +240,39 @@ def derive_collective_corrections(reports) -> dict:
     executor must never calibrate the chip's ICI terms. These land in
     CALIBRATION.json ``collective_corrections`` — the measured hook for
     the machine model's per-kind collective costs (ROADMAP chip item
-    (a): calibrate ``wus_rs/ag_time`` against measured RS/AG)."""
+    (a): calibrate ``wus_rs/ag_time`` against measured RS/AG).
+
+    Rows marked ``ingestable: false`` (CPU-platform measurements — the
+    thunk executor's host wall time vs analytic ICI pricing is backend
+    mismatch, hundreds-x "drift", not calibration signal) are SKIPPED
+    with a warning; reports from a CPU platform without the flag
+    (pre-flag artifacts) are skipped the same way."""
     num: dict = {}  # (platform, kind) -> share-weighted ratio sum
     den: dict = {}
+    skipped = 0
     for rep in reports:
         cd = rep.get("collective_drift") or {}
-        rows = {k: r for k, r in cd.items()
-                if r.get("ratio") and r.get("predicted_s")}
+        platform = (rep.get("header") or {}).get("platform") or "unknown"
+        rows = {}
+        for k, r in cd.items():
+            if not (r.get("ratio") and r.get("predicted_s")):
+                continue
+            if r.get("ingestable") is False or platform == "cpu":
+                skipped += 1
+                continue
+            rows[k] = r
         total_pred = sum(float(r["predicted_s"]) for r in rows.values())
         if total_pred <= 0:
             continue
-        platform = (rep.get("header") or {}).get("platform") or "unknown"
         for kind, r in rows.items():
             share = float(r["predicted_s"]) / total_pred
             num[(platform, kind)] = (num.get((platform, kind), 0.0)
                                      + share * float(r["ratio"]))
             den[(platform, kind)] = den.get((platform, kind), 0.0) + share
+    if skipped:
+        print(f"  [warn] skipped {skipped} non-ingestable collective-drift "
+              f"row(s): CPU-backend measured-vs-analytic-ICI ratios are "
+              f"not calibration signal")
     out: dict = {}
     for (platform, kind) in sorted(num):
         if den[(platform, kind)] <= 0:

@@ -24,7 +24,8 @@ import re
 import sys
 import time
 
-SUFFIXES = ("counters", "devtrace", "drift", "summary")
+SUFFIXES = ("counters", "devtrace", "drift", "summary", "simtrace",
+            "searchtrace")
 
 
 def _load(path):
@@ -53,14 +54,70 @@ def _round(v, nd=6):
     return round(v, nd) if isinstance(v, (int, float)) else v
 
 
+def per_op_attribution(simtrace, drift, limit=24):
+    """Join the simulated schedule's per-op priced terms against measured
+    per-op seconds — the per-op granularity of the drift table (the
+    learned-cost-model corpus rows). The simtrace rows carry the priced
+    half plus any profile-table measurement; the drift report's per_op
+    rows fill in the measured/analytic fallback.
+
+    The two halves are NOT directly comparable: priced terms are
+    per-chip SHARDED schedule durations (and include comms), measured
+    seconds are whole-op UNSHARDED profile times. The ``ratio`` column
+    therefore compares sharded measured compute (``measured_s`` /
+    ``work_div``) against the priced COMPUTE terms only (fwd+bwd);
+    ``predicted_s`` keeps the full per-chip total (with comms) as its
+    own column. Rows sorted by predicted share, capped at ``limit``
+    (``truncated`` records how many were dropped)."""
+    sim_ops = (simtrace or {}).get("per_op") or []
+    if not sim_ops:
+        return None
+    drift_ops = {r.get("guid"): r for r in (drift or {}).get("per_op") or []}
+    rows = []
+    for r in sim_ops:
+        p = r.get("priced") or {}
+        predicted = sum(p.get(k) or 0.0
+                        for k in ("fwd_s", "bwd_s", "comm_s", "gradsync_s"))
+        predicted_compute = (p.get("fwd_s") or 0.0) + (p.get("bwd_s") or 0.0)
+        d = drift_ops.get(r.get("guid")) or {}
+        m = r.get("measured") or {}
+        measured = None
+        source = m.get("source")
+        if m.get("fwd_s") is not None:
+            measured = (m.get("fwd_s") or 0.0) + (m.get("bwd_s") or 0.0)
+        elif d.get("source") == "measured" and d.get("fwd_s") is not None:
+            measured = (d.get("fwd_s") or 0.0) + (d.get("bwd_s") or 0.0)
+            source = "measured"
+        row = dict(name=r.get("name"), type=r.get("type"),
+                   choice=r.get("choice"),
+                   predicted_s=_round(predicted, 9))
+        if measured is not None:
+            div = r.get("work_div") or d.get("work_div") or 1
+            row["measured_s"] = _round(measured, 9)
+            row["work_div"] = div
+            row["source"] = source
+            if predicted_compute > 0 and measured > 0 and div > 0:
+                row["ratio"] = _round(
+                    (measured / div) / predicted_compute, 4)
+        rows.append(row)
+    rows.sort(key=lambda r: -(r.get("predicted_s") or 0.0))
+    out = dict(ops=len(rows), rows=rows[:limit])
+    if len(rows) > limit:
+        out["truncated"] = len(rows) - limit
+    return out
+
+
 def summarize_run(stem, arts):
     """One report row per run stem, from whichever artifacts exist."""
     drift = arts.get("drift") or {}
     devtrace = arts.get("devtrace") or {}
     counters = arts.get("counters") or {}
     summary = arts.get("summary") or {}
+    simtrace = arts.get("simtrace") or {}
+    searchtrace = arts.get("searchtrace") or {}
     header = (drift.get("header") or devtrace.get("header")
-              or counters.get("header") or summary.get("header") or {})
+              or counters.get("header") or summary.get("header")
+              or simtrace.get("header") or {})
     m = re.match(r"(.+)_r\d+_host\d+$", stem)
     run_name = header.get("run_name") or (m.group(1) if m else stem)
     row = dict(run=stem, run_name=run_name,
@@ -78,6 +135,11 @@ def summarize_run(stem, arts):
     if p99 is not None:
         row["step_time_p99_s"] = _round(p99)
     gauges = counters.get("gauges") or {}
+    # compile step recorded separately (never in the percentile reservoir)
+    compile_s = gauges.get(f"{run_name}/compile_time_s",
+                           metrics.get("compile_time_s"))
+    if compile_s is not None:
+        row["compile_time_s"] = _round(compile_s)
     for key in ("goodput", "mfu"):
         v = gauges.get(f"{run_name}/{key}", metrics.get(key))
         if v is not None:
@@ -105,7 +167,9 @@ def summarize_run(stem, arts):
             row["collective_drift"] = {
                 k: dict(predicted_s=_round(e.get("predicted_s"), 9),
                         measured_s=_round(e.get("measured_s"), 9),
-                        ratio=_round(e.get("ratio"), 4))
+                        ratio=_round(e.get("ratio"), 4),
+                        **({"ingestable": e["ingestable"]}
+                           if "ingestable" in e else {}))
                 for k, e in cd.items()}
     if summary:
         mem = summary.get("memory") or {}
@@ -114,6 +178,33 @@ def summarize_run(stem, arts):
         tot = summary.get("collectives_total") or {}
         if tot:
             row["collective_bytes"] = tot.get("bytes")
+    if simtrace:
+        pred = simtrace.get("predicted") or {}
+        sim = dict(predicted_step_s=_round(pred.get("step_s"), 9),
+                   fwd_s=_round(pred.get("fwd_s"), 9),
+                   bwd_s=_round(pred.get("bwd_s"), 9),
+                   comm_s=_round(pred.get("comm_s"), 9),
+                   gradsync_s=_round(pred.get("gradsync_s"), 9))
+        meas_p50 = row.get("step_time_p50_s")
+        if pred.get("step_s") and meas_p50:
+            sim["predicted_vs_measured"] = _round(
+                pred["step_s"] / meas_p50, 4)
+        row["sim"] = sim
+        attr = per_op_attribution(simtrace, drift)
+        if attr:
+            row["per_op_attribution"] = attr
+    if searchtrace:
+        meshes = searchtrace.get("meshes") or []
+        by_status = {}
+        for m in meshes:
+            s = m.get("status", "unknown")
+            # illegal rows are aggregated per gate with a firing count
+            by_status[s] = by_status.get(s, 0) + int(m.get("count", 1))
+        row["search"] = dict(
+            schema_version=searchtrace.get("schema_version"),
+            winner_mesh=searchtrace.get("winner_mesh"),
+            mesh_candidates=sum(by_status.values()),
+            mesh_status=by_status)
     return row
 
 
@@ -166,13 +257,45 @@ def to_markdown(report):
               for k, e in (r.get("collective_drift") or {}).items()]
     if drifts:
         lines += ["", "## Measured vs priced collectives", "",
-                  "| run | kind | predicted s | measured s | ratio |",
-                  "|---|---|---|---|---|"]
+                  "| run | kind | predicted s | measured s | ratio | "
+                  "ingestable |",
+                  "|---|---|---|---|---|---|"]
         for run, kind, e in drifts:
+            ing = e.get("ingestable")
             lines.append(f"| {run} | {kind} | "
                          f"{_fmt(e.get('predicted_s'), nd=9)} | "
                          f"{_fmt(e.get('measured_s'), nd=9)} | "
-                         f"{_fmt(e.get('ratio'))} |")
+                         f"{_fmt(e.get('ratio'))} | "
+                         f"{'-' if ing is None else ing} |")
+    sims = [r for r in report["runs"] if r.get("sim")]
+    if sims:
+        lines += ["", "## Simulated vs measured step", "",
+                  "| run | predicted step ms | measured p50 ms | "
+                  "pred/meas |",
+                  "|---|---|---|---|"]
+        for r in sims:
+            s = r["sim"]
+            lines.append(
+                f"| {r['run']} | {_fmt(s.get('predicted_step_s'), 1e3)} | "
+                f"{_fmt(r.get('step_time_p50_s'), 1e3)} | "
+                f"{_fmt(s.get('predicted_vs_measured'))} |")
+    attrs = [(r["run"], row) for r in report["runs"]
+             for row in (r.get("per_op_attribution") or {}).get("rows", [])]
+    if attrs:
+        lines += ["", "## Per-op predicted vs measured", "",
+                  "(measured = whole-op profile seconds; compute ratio "
+                  "= (measured / work_div) / priced fwd+bwd)", "",
+                  "| run | op | type | choice | predicted ms | "
+                  "measured ms | div | compute ratio |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for run, row in attrs:
+            lines.append(
+                f"| {run} | {row.get('name')} | {row.get('type')} | "
+                f"{row.get('choice') or '-'} | "
+                f"{_fmt(row.get('predicted_s'), 1e3)} | "
+                f"{_fmt(row.get('measured_s'), 1e3)} | "
+                f"{row.get('work_div', '-')} | "
+                f"{_fmt(row.get('ratio'))} |")
     return "\n".join(lines) + "\n"
 
 

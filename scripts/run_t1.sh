@@ -10,6 +10,13 @@
 # Lint ERRORs fail the gate only when the tests themselves passed, so a
 # test regression is never masked by a lint exit code.
 #
+# An explain stage runs scripts/explain.py over one zoo model, emitting
+# SEARCH_TRACE.json + EXPLAIN.md (search provenance: per-mesh candidates
+# with rejection reasons, chosen-vs-runner-up per-op costs, simulated
+# timeline) next to FFLINT.json. It merges the simulated sim: lanes into
+# the tier-1 trace dir so the devtrace smoke's measured lanes sit beside
+# them. Non-fatal: a broken explain never fails the gate.
+#
 # An obs stage then renders OBS_REPORT.json from the tier-1 trace dir:
 # FFS_T1_TRACE_DIR points the devtrace smoke test (tests/test_devtrace.py)
 # at a stable location, and scripts/obs_report.py rolls whatever
@@ -27,6 +34,8 @@ fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c);
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then echo "FFLINT: exit $lint_rc (see FFLINT.json / /tmp/_t1_lint.err)"; else echo "FFLINT: clean (FFLINT.json)"; fi
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/explain.py --model transformer --out-dir . --trace-dir "$FFS_T1_TRACE_DIR" > /dev/null 2> /tmp/_t1_explain.err; explain_rc=$?
+if [ "$explain_rc" -ne 0 ]; then echo "EXPLAIN: failed (exit $explain_rc, see /tmp/_t1_explain.err) — non-fatal"; else echo "EXPLAIN: written (SEARCH_TRACE.json, EXPLAIN.md)"; fi
 timeout -k 10 120 python scripts/obs_report.py "$FFS_T1_TRACE_DIR" --out OBS_REPORT.json > /dev/null 2> /tmp/_t1_obs.err; obs_rc=$?
 if [ "$obs_rc" -ne 0 ]; then echo "OBS: report failed (exit $obs_rc, see /tmp/_t1_obs.err) — non-fatal"; else echo "OBS: report written (OBS_REPORT.json)"; fi
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi

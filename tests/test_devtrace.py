@@ -378,11 +378,23 @@ class TestProfiledFit:
         assert mod.main([str(tmp_path / "empty"), "--out", out]) == 0
         assert json.load(open(out))["runs"] == []
 
-    def test_ingest_collective_corrections(self, profiled_run, tmp_path,
-                                           monkeypatch):
-        """Acceptance: measured-vs-priced collective drift round-trips
-        through calibrate.py --ingest-drift into CALIBRATION.json
-        per-collective corrections (platform-bucketed)."""
+    def test_drift_rows_marked_uningestable_on_cpu(self, profiled_run):
+        # deviceless capture: the measured half is host-CPU wall time,
+        # the predicted half analytic ICI — the rows must carry
+        # ingestable: false so calibration never eats the 400-600x
+        # backend-mismatch "drift"
+        td, _ = profiled_run
+        rep = json.load(open(self._one(td, "fit_*.drift.json")))
+        for row in rep["collective_drift"].values():
+            assert row["ingestable"] is False
+
+    def test_ingest_skips_cpu_collective_drift(self, profiled_run,
+                                               tmp_path, monkeypatch,
+                                               capsys):
+        """CPU-platform collective-drift rows are skipped with a warning
+        by calibrate.py --ingest-drift: no collective_corrections bucket
+        is derived from a deviceless run (op_corrections, which ARE
+        platform-meaningful, still land in the cpu bucket)."""
         import importlib.util
         td, _ = profiled_run
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -396,9 +408,38 @@ class TestProfiledFit:
                             lambda p: str(fake_repo / "scripts" / "x.py"))
         assert mod.ingest_drift(td) == 0
         cal = json.load(open(fake_repo / "CALIBRATION.json"))
-        corr = cal["collective_corrections"]["cpu"]
-        assert corr["all-reduce"]["factor"] > 0
-        assert corr["all-reduce"]["weight"] > 0
+        assert "cpu" not in (cal.get("collective_corrections") or {})
+        assert "cpu" in cal["op_corrections"]
+        assert "non-ingestable collective-drift" in capsys.readouterr().out
+
+    def test_ingest_chip_collective_drift_still_lands(self, tmp_path,
+                                                      monkeypatch):
+        """A TPU-platform drift report (ingestable rows) still derives
+        per-kind collective corrections — the skip is CPU-only."""
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "calibrate2", os.path.join(repo, "scripts", "calibrate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        td = tmp_path / "trace"
+        td.mkdir()
+        rep = dict(
+            header=dict(run_name="fit", platform="tpu"),
+            predicted=dict(total_s=1e-3), measured=dict(step_s=1.2e-3),
+            ratio=1.2, per_op=[],
+            collective_drift={"all-reduce": dict(
+                predicted_s=1e-4, measured_s=1.3e-4, ratio=1.3,
+                ingestable=True)})
+        (td / "fit_r00_host00.drift.json").write_text(json.dumps(rep))
+        fake_repo = tmp_path / "repo"
+        (fake_repo / "scripts").mkdir(parents=True)
+        monkeypatch.setattr(mod.os.path, "abspath",
+                            lambda p: str(fake_repo / "scripts" / "x.py"))
+        assert mod.ingest_drift(str(td)) == 0
+        cal = json.load(open(fake_repo / "CALIBRATION.json"))
+        corr = cal["collective_corrections"]["tpu"]
+        assert corr["all-reduce"]["factor"] == pytest.approx(1.3)
 
     def test_profile_without_trace_dir_degrades(self, capsys):
         # --profile-steps without --trace-dir must warn and train, not

@@ -1,0 +1,133 @@
+"""Golden-file tests for scripts/obs_report.py (ISSUE 8 satellite).
+
+The report renderer previously had no direct tests — it was only
+exercised incidentally through the devtrace acceptance fit. These tests
+render a COMMITTED fixture trace dir (tests/fixtures/obs_report_dir,
+one run stem carrying every artifact kind the renderer consumes) and
+assert the run row, the devtrace block, the drift table, the simulated
+-vs-measured join, and the empty-dir exit-0 path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "obs_report_dir")
+
+
+@pytest.fixture(scope="module")
+def mod():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_golden", os.path.join(REPO, "scripts",
+                                          "obs_report.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+@pytest.fixture(scope="module")
+def report(mod):
+    return mod.build_report(FIXTURE)
+
+
+class TestGoldenReport:
+    def test_run_row(self, report):
+        assert len(report["runs"]) == 1
+        r = report["runs"][0]
+        assert r["run"] == "demo_r00_host00"
+        assert r["run_name"] == "demo"
+        assert r["platform"] == "tpu"
+        assert r["version"] == "0.1.0"
+        # percentile fields come from the counters reservoir
+        assert r["step_time_p50_s"] == pytest.approx(0.02)
+        assert r["step_time_p99_s"] == pytest.approx(0.034)
+        # compile step recorded separately, never inside the reservoir
+        assert r["compile_time_s"] == pytest.approx(12.25)
+        assert r["goodput"] == pytest.approx(0.998)
+        assert r["mfu"] == pytest.approx(0.41)
+        assert r["hbm_peak_bytes"] == pytest.approx(536870912.0)
+        assert r["collective_bytes"] == pytest.approx(1048576.0)
+
+    def test_devtrace_block(self, report):
+        dt = report["runs"][0]["devtrace"]
+        assert dt["steps"] == 2
+        assert dt["window"] == [2, 4]
+        assert dt["exposed_comms_frac"] == pytest.approx(
+            0.008 / 0.041, rel=1e-3)
+        assert dt["collectives"]["all-reduce"]["count"] == 24
+
+    def test_drift_table(self, report):
+        cd = report["runs"][0]["collective_drift"]
+        assert cd["all-reduce"]["ratio"] == pytest.approx(1.15)
+        assert cd["all-reduce"]["predicted_s"] == pytest.approx(0.005)
+        # the ingestability stamp survives into the report
+        assert cd["all-reduce"]["ingestable"] is True
+        assert report["runs"][0]["drift_ratio"] == pytest.approx(0.95)
+
+    def test_sim_block(self, report):
+        sim = report["runs"][0]["sim"]
+        assert sim["predicted_step_s"] == pytest.approx(0.0185)
+        # predicted vs measured p50: the simulated-vs-measured timeline
+        # coordinate of the report
+        assert sim["predicted_vs_measured"] == pytest.approx(
+            0.0185 / 0.02, rel=1e-3)
+
+    def test_per_op_attribution_joins_corpus_row(self, report):
+        """Acceptance: one row joins op -> priced terms -> measured
+        seconds (the learned-cost-model corpus format)."""
+        attr = report["runs"][0]["per_op_attribution"]
+        assert attr["ops"] == 2
+        by_name = {r["name"]: r for r in attr["rows"]}
+        d1 = by_name["dense1"]
+        assert d1["type"] == "LINEAR"
+        assert d1["choice"] == "dp"
+        # priced half: fwd+bwd+comm+gradsync from the simulated schedule
+        assert d1["predicted_s"] == pytest.approx(
+            0.0035 + 0.007 + 0.0 + 0.0015)
+        # measured half: the profile table's whole-op per-op seconds
+        assert d1["measured_s"] == pytest.approx(0.003 + 0.006)
+        assert d1["source"] == "measured"
+        # the ratio compares COMPARABLE quantities: sharded measured
+        # compute (measured / work_div) vs the priced compute terms
+        # (fwd+bwd only — predicted_s also carries comms)
+        assert d1["work_div"] == 8
+        assert d1["ratio"] == pytest.approx(
+            (d1["measured_s"] / 8) / (0.0035 + 0.007), rel=1e-3)
+        # an op without a measured row stays priced-only
+        assert "measured_s" not in by_name["dense2"]
+
+    def test_search_block(self, report):
+        s = report["runs"][0]["search"]
+        assert s["schema_version"] == 1
+        assert s["winner_mesh"]["data"] == 8
+        assert s["mesh_candidates"] == 4
+        assert s["mesh_status"] == dict(winner=1, dominated=1,
+                                        over_budget=1, illegal=1)
+
+    def test_markdown_sections(self, mod, report):
+        md = mod.to_markdown(report)
+        assert "# Observability run report" in md
+        assert "## Measured vs priced collectives" in md
+        assert "## Simulated vs measured step" in md
+        assert "## Per-op predicted vs measured" in md
+        assert "demo_r00_host00" in md
+
+    def test_main_writes_outputs(self, mod, tmp_path):
+        out = str(tmp_path / "OBS_REPORT.json")
+        md = str(tmp_path / "OBS_REPORT.md")
+        assert mod.main([FIXTURE, "--out", out, "--md", md]) == 0
+        rep = json.load(open(out))
+        assert rep["runs"][0]["run"] == "demo_r00_host00"
+        assert "Per-op predicted vs measured" in open(md).read()
+
+    def test_empty_dir_exit_zero(self, mod, tmp_path):
+        out = str(tmp_path / "empty" / "OBS_REPORT.json")
+        assert mod.main([str(tmp_path / "empty"), "--out", out]) == 0
+        rep = json.load(open(out))
+        assert rep["runs"] == []
+        assert "note" in rep

@@ -1,0 +1,368 @@
+"""Search provenance & simulator observability (ISSUE 8 tentpole).
+
+Covers the three layers the tentpole added:
+
+- the native structured search trace (``emit_search_trace``): schema
+  version, per-mesh candidate rows with rejection reasons, frontier-DP
+  evolution arithmetic, per-op candidate-choice cost decomposition;
+- the simulated-schedule layer (``obs/simtrace.py``): sim: Perfetto
+  lanes next to the measured device lanes, the ``.simtrace.json``
+  artifact, and the learned-cost-model corpus row join
+  (op -> priced terms -> measured seconds — the acceptance row);
+- ``scripts/explain.py``: SEARCH_TRACE.json + EXPLAIN.md + a merged
+  Perfetto trace carrying a ``sim:`` lane.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.search
+
+
+def _tiny_graph_nodes():
+    """Two stacked Linears over a [32, 64] input — enough for dp / col /
+    row / wus choices and a dominated frontier."""
+    roles = [["sample", "channel"]]
+    return [
+        dict(guid=1, type="INPUT", name="x", inputs=[], input_shapes=[],
+             output_shapes=[[32, 64]], roles=roles, params={},
+             flops=0.0, dtype_size=4, attrs={}),
+        dict(guid=2, type="LINEAR", name="dense1", inputs=[[1, 0]],
+             input_shapes=[[32, 64]], output_shapes=[[32, 128]],
+             roles=roles, params={"kernel": [64, 128], "bias": [128]},
+             flops=32 * 64 * 128 * 2.0, dtype_size=4, attrs={}),
+        dict(guid=3, type="LINEAR", name="dense2", inputs=[[2, 0]],
+             input_shapes=[[32, 128]], output_shapes=[[32, 10]],
+             roles=roles, params={"kernel": [128, 10], "bias": [10]},
+             flops=32 * 128 * 10 * 2.0, dtype_size=4, attrs={}),
+    ]
+
+
+def _native_request(**config):
+    cfg = dict(budget=1, training=True, enable_substitution=False,
+               batch=32)
+    cfg.update(config)
+    return dict(
+        nodes=_tiny_graph_nodes(),
+        machine=dict(num_devices=8, flops=1e12, hbm_bw=1e11, hbm_cap=16e9,
+                     ici_bw=1e10, ici_latency=1e-6, dcn_bw=1e9,
+                     dcn_latency=1e-5, num_slices=1, mxu_efficiency=0.55,
+                     conv_efficiency=0.35, min_op_time=5e-7,
+                     comm_bytes_factor=1.0, torus=[]),
+        config=cfg,
+        measured={},
+    )
+
+
+class TestNativeSearchTrace:
+    @pytest.fixture(scope="class")
+    def trace(self):
+        from flexflow_tpu.search.native import native_optimize
+        resp = native_optimize(_native_request(emit_search_trace=True))
+        assert "search_trace" in resp
+        return resp["search_trace"]
+
+    def test_schema_versioned(self, trace):
+        assert trace["schema_version"] == 1
+        assert trace["graph"] in ("original", "rewritten")
+        assert trace["config"]["training"] is True
+
+    def test_off_by_default(self):
+        from flexflow_tpu.search.native import native_optimize
+        resp = native_optimize(_native_request())
+        assert "search_trace" not in resp
+
+    def test_mesh_rows_carry_rejection_reasons(self, trace):
+        statuses = {}
+        for row in trace["meshes"]:
+            statuses.setdefault(row["status"], []).append(row)
+            if row["status"] != "winner":
+                assert row.get("reason"), row
+        # exactly one winner; dominated rows priced end-to-end; illegal
+        # factorizations name the legality gate that rejected them
+        assert len(statuses["winner"]) == 1
+        assert statuses["dominated"]
+        for row in statuses["dominated"]:
+            assert row["time_s"] > statuses["winner"][0]["time_s"]
+            assert row["reason"] == "slower_than_winner"
+        for row in statuses.get("illegal", []):
+            assert row["reason"] in (
+                "parameter_parallel_disabled", "only_data_parallel",
+                "no_seq_dim", "seq_extent_indivisible", "no_expert_ops",
+                "experts_indivisible", "pipeline_disabled",
+                "no_repeated_blocks", "pipe_composes_with_dp_only",
+                "blocks_indivisible_by_stages", "batch_indivisible_by_dp",
+                "pinned_axis_extent_mismatch", "inner_axes_cross_slice")
+
+    def test_winner_matches_response_mesh(self, trace):
+        from flexflow_tpu.search.native import native_optimize
+        resp = native_optimize(_native_request(emit_search_trace=True))
+        assert resp["search_trace"]["winner_mesh"] == resp["mesh"]
+
+    def test_dp_evolution_arithmetic(self, trace):
+        evo = trace["dp_evolution"]
+        assert len(evo) == len(_tiny_graph_nodes())
+        for row in evo:
+            assert row["expanded"] == row["states_in"] * row["choices"]
+            assert (row["unique_frontiers"] + row["pruned_dominated"]
+                    == row["expanded"])
+            assert (row["kept"] + row["pruned_alpha"] + row["pruned_beam"]
+                    == row["unique_frontiers"])
+            assert row["kept"] >= 1
+            assert row["best_cost"] >= 0
+
+    def test_per_op_candidates_decomposed(self, trace):
+        ops = {o["name"]: o for o in trace["ops"]}
+        d1 = ops["dense1"]
+        names = [c["choice"] for c in d1["candidates"]]
+        assert "rep" in names
+        chosen = [c for c in d1["candidates"] if c["chosen"]]
+        assert len(chosen) == 1
+        assert chosen[0]["choice"] == d1["chosen"]
+        for c in d1["candidates"]:
+            t = c["terms"]
+            for key in ("fwd_s", "bwd_s", "compute_s", "comm_s",
+                        "gradsync_s", "collective_s", "opt_state_s",
+                        "total_s"):
+                assert key in t
+            assert t["compute_s"] == pytest.approx(t["fwd_s"] + t["bwd_s"])
+            assert t["collective_s"] == pytest.approx(
+                t["comm_s"] + t["gradsync_s"])
+            assert t["total_s"] == pytest.approx(
+                t["compute_s"] + t["collective_s"] + t["opt_state_s"],
+                rel=1e-6)
+            m = c["memory"]
+            assert m["param_bytes"] >= 0
+            assert m["opt_state_bytes"] >= 0
+            assert m["act_bytes"] >= 0
+
+    def test_choice_collectives_described(self, trace):
+        # SOME candidate on the winning mesh implies wire traffic, and
+        # every implied collective names its kind/bytes/ring/cause
+        described = [e for o in trace["ops"]
+                     for c in o["candidates"]
+                     for e in c["collectives"]]
+        assert described
+        for e in described:
+            assert e["kind"] in ("allreduce", "allgather", "ppermute")
+            assert e["bytes"] > 0
+            assert e["ring"] > 1
+            assert e["cause"]
+
+    def test_dp_mesh_gradsync_and_wus_collectives(self):
+        """On a data-parallel mesh the dp choice implies the gradient
+        all-reduce and its _wus twin the reduce-scatter + param
+        all-gather pair — the collective column of the explain table."""
+        from flexflow_tpu.search.native import native_optimize
+        resp = native_optimize(_native_request(
+            emit_search_trace=True, only_data_parallel=True))
+        tr = resp["search_trace"]
+        assert tr["winner_mesh"]["data"] == 8
+        ops = {o["name"]: o for o in tr["ops"]}
+        cands = {c["choice"]: c for c in ops["dense1"]["candidates"]}
+        dp = cands["dp"]
+        assert {e["cause"] for e in dp["collectives"]} == \
+            {"grad_allreduce"}
+        wus = cands["dp_wus"]
+        assert {e["cause"] for e in wus["collectives"]} == \
+            {"grad_reduce_scatter", "wus_param_allgather"}
+        # WUS shards the optimizer state over the gradient ring: its
+        # memory row must show the shrink the DP weighed
+        assert (wus["memory"]["opt_state_bytes"]
+                < dp["memory"]["opt_state_bytes"])
+
+
+class TestSimLaneEvents:
+    def test_lanes_and_zero_duration_filter(self):
+        from flexflow_tpu.obs.simtrace import (SIM_TID_COMMS,
+                                               SIM_TID_COMPUTE,
+                                               sim_lane_events)
+        tasks = [
+            dict(kind="fwd", node=0, start=0.0, finish=1e-3),
+            dict(kind="gradsync", node=0, start=1e-3, finish=2e-3,
+                 collective="allreduce", bytes=4096),
+            dict(kind="comm", node=1, start=0.0, finish=0.0,
+                 collective="ppermute", bytes=128),  # census-only record
+        ]
+        evs = sim_lane_events(tasks, {0: "dense1", 1: "dense2"},
+                              t0_us=100.0)
+        assert len(evs) == 2  # zero-duration census row skipped
+        fwd, gs = evs
+        assert fwd["name"] == "dense1:fwd"
+        assert fwd["tid"] == SIM_TID_COMPUTE
+        assert fwd["ts"] == pytest.approx(100.0)
+        assert fwd["dur"] == pytest.approx(1e3)
+        assert gs["tid"] == SIM_TID_COMMS
+        assert gs["args"]["collective"] == "allreduce"
+        assert gs["ts"] == pytest.approx(100.0 + 1e3)
+
+
+@pytest.fixture(scope="module")
+def searched_mlp():
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.models.mlp import create_mlp
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    cfg = FFConfig(batch_size=16)
+    cfg.search_budget = 2
+    cfg.enable_parameter_parallel = True
+    cfg.enable_pipeline_parallel = False
+    cfg.search_trace = True
+    ff = create_mlp(batch_size=16, in_dim=64, hidden_dims=(128, 128),
+                    out_dim=10, ff_config=cfg)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+class TestCorpusRows:
+    def test_row_joins_op_priced_measured(self, searched_mlp):
+        """Acceptance: one corpus row joins op identity (class, shape,
+        sharding choice) -> the simulator's priced terms -> measured
+        per-op seconds — the learned-TPU-cost-model training format."""
+        from flexflow_tpu.obs.simtrace import corpus_rows
+        from flexflow_tpu.search.validate import simulate_strategy
+
+        ff = searched_mlp
+        resp = simulate_strategy(ff)
+        # a measured table as --profiling / --search-measure-ops builds
+        guid = ff.executor.nodes[1].op.guid
+        measured = {f"{guid}:fwd": 3.1e-4, f"{guid}:bwd": 6.2e-4}
+        rows = corpus_rows(ff, resp, measured=measured)
+        assert len(rows) == len(ff.executor.nodes)
+        by_guid = {r["guid"]: r for r in rows}
+        row = by_guid[guid]
+        # op identity
+        assert row["type"] == "LINEAR"
+        assert row["out_shape"]
+        assert row["choice"]  # the searched sharding choice
+        # priced terms from the simulated schedule
+        assert row["priced"]["fwd_s"] > 0
+        assert row["priced"]["bwd_s"] > 0
+        # measured seconds + provenance
+        assert row["measured"]["fwd_s"] == pytest.approx(3.1e-4)
+        assert row["measured"]["bwd_s"] == pytest.approx(6.2e-4)
+        assert row["measured"]["source"] == "measured"
+        # an op absent from the table is priced-only, source None
+        other = next(r for r in rows if r["guid"] != guid
+                     and r["type"] == "LINEAR")
+        assert other["measured"]["source"] is None
+
+    def test_searched_choice_recorded(self, searched_mlp):
+        from flexflow_tpu.obs.simtrace import corpus_rows
+        from flexflow_tpu.search.validate import simulate_strategy
+
+        rows = corpus_rows(searched_mlp, simulate_strategy(searched_mlp))
+        choices = {r["choice"] for r in rows if r["type"] == "LINEAR"}
+        assert choices  # the strategy's choice names ride along
+
+
+class TestSearchedFitArtifacts:
+    @pytest.fixture(scope="class")
+    def traced_fit(self, tmp_path_factory):
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.models.mlp import create_mlp
+        from flexflow_tpu.optimizers import SGDOptimizer
+
+        td = str(tmp_path_factory.mktemp("searchtrace"))
+        cfg = FFConfig(batch_size=16)
+        cfg.search_budget = 2
+        cfg.enable_parameter_parallel = True
+        cfg.enable_pipeline_parallel = False
+        cfg.search_trace = True
+        ff = create_mlp(batch_size=16, in_dim=64, hidden_dims=(128, 128),
+                        out_dim=10, ff_config=cfg)
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 64).astype(np.float32)
+        y = rs.randint(0, 10, size=(64, 1)).astype(np.int32)
+        ff.fit(x, y, epochs=1, verbose=False, trace_dir=td)
+        return td, ff
+
+    def _one(self, td, pattern):
+        paths = glob.glob(os.path.join(td, pattern))
+        assert len(paths) == 1, f"{pattern}: {paths}"
+        return paths[0]
+
+    def test_searchtrace_artifact(self, traced_fit):
+        td, ff = traced_fit
+        st = json.load(open(self._one(td, "fit_*.searchtrace.json")))
+        assert st["schema_version"] == 1
+        assert st["header"]["kind"] == "searchtrace"
+        mesh = st["winner_mesh"]
+        axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+        for k, v in axes.items():
+            assert mesh.get(k, 1) == v
+        assert any(o["candidates"] for o in st["ops"])
+
+    def test_simtrace_artifact(self, traced_fit):
+        td, _ = traced_fit
+        sim = json.load(open(self._one(td, "fit_*.simtrace.json")))
+        assert sim["predicted"]["step_s"] > 0
+        assert sim["tasks"] > 0
+        assert sim["per_op"]
+        for r in sim["per_op"]:
+            assert "priced" in r and "measured" in r
+
+    def test_sim_lanes_in_perfetto_trace(self, traced_fit):
+        td, _ = traced_fit
+        trace = json.load(open(self._one(td, "fit_*.trace.json")))
+        events = trace["traceEvents"]
+        lanes = {e["args"]["name"] for e in events
+                 if e.get("name") == "thread_name"}
+        assert {"sim:compute", "sim:comms"} <= lanes
+        sim = [e for e in events if e.get("cat") == "simtrace"]
+        assert sim
+        # aligned onto the tracer timeline: the sim step starts at a
+        # traced step's start
+        steps = [e["ts"] for e in events
+                 if e.get("name") == "step" and e.get("ph") == "X"]
+        assert min(e["ts"] for e in sim) == pytest.approx(
+            max(steps), abs=1e3)
+
+    def test_merged_trace_keeps_sim_lanes(self, traced_fit):
+        td, _ = traced_fit
+        from flexflow_tpu.obs import merge_host_traces
+        data = json.load(open(merge_host_traces(td)))
+        labels = {e["args"]["name"] for e in data["traceEvents"]
+                  if e.get("name") == "thread_name"}
+        assert any(l.endswith(":sim:compute") for l in labels)
+        assert any(l.endswith(":sim:comms") for l in labels)
+
+
+class TestExplainCLI:
+    def test_explain_end_to_end(self, tmp_path, monkeypatch):
+        spec = importlib.util.spec_from_file_location(
+            "explain_cli", os.path.join(REPO, "scripts", "explain.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = str(tmp_path / "out")
+        monkeypatch.setattr(sys, "argv", [
+            "explain.py", "--model", "mlp", "--budget", "1",
+            "--out-dir", out])
+        assert mod.main() == 0
+        st = json.load(open(os.path.join(out, "SEARCH_TRACE.json")))
+        assert st["search_trace"]["schema_version"] == 1
+        assert st["corpus"]  # learned-cost-model rows ride along
+        assert st["corpus"][0]["priced"]
+        md = open(os.path.join(out, "EXPLAIN.md")).read()
+        assert "Chosen vs runner-up" in md
+        assert "Mesh candidates" in md
+        assert "Simulated timeline path" in md
+        merged = json.load(open(st["merged_trace"]))
+        labels = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("name") == "thread_name"}
+        assert any("sim:compute" in l for l in labels)
