@@ -345,11 +345,18 @@ def ratchet(hist, key, samples_per_s, config, protocol):
         (old if old != protocol else None)
 
 
-def _low_water_ratchet(hist, key, field, value, tol):
-    """Shared downward ratchet for compile-determined metrics (census
-    bytes, HBM peak): lower is better; a new low updates ``field`` in
-    the workload's history entry, anything more than ``tol`` above the
-    recorded best is a regression. Returns (regression, baseline)."""
+def _low_water_ratchet(hist, key, field, value, tol, abs_tol=0.0,
+                       skip=False, max_drop=None):
+    """Shared downward ratchet (census bytes, HBM peak, exposed-comms
+    fraction): lower is better; a new low updates ``field`` in the
+    workload's history entry, anything more than ``tol`` relative plus
+    ``abs_tol`` absolute above the recorded best is a regression.
+    ``skip`` suppresses the flag (the low-water value still records).
+    For MEASURED metrics ``max_drop`` bounds how far one run can tighten
+    the baseline (e.g. 0.5 = at most halve it per round): a single
+    outlier-low capture window must not set a floor typical runs can
+    never meet again, while sustained genuine improvement still
+    converges geometrically. Returns (regression, baseline)."""
     entry = hist.get(key)
     if not isinstance(entry, dict):
         # legacy bare-number entry: preserve it as the samples/s baseline
@@ -358,9 +365,13 @@ def _low_water_ratchet(hist, key, field, value, tol):
                  if isinstance(entry, (int, float)) else {})
         hist[key] = entry
     baseline = entry.get(field)
-    regression = baseline is not None and value > baseline * (1.0 + tol)
-    if baseline is None or value < baseline:
+    regression = (not skip and baseline is not None
+                  and value > baseline * (1.0 + tol) + abs_tol)
+    if baseline is None:
         entry[field] = float(value)
+    elif value < baseline:
+        floor = baseline * max_drop if max_drop else 0.0
+        entry[field] = float(max(value, floor))
     return regression, baseline
 
 
@@ -462,6 +473,23 @@ def mfu_of(ff, step_s):
         return None
 
 
+def exposed_ratchet(hist, key, frac, tol=0.25, abs_tol=0.01):
+    """Downward ratchet on the measured exposed-comms fraction (ISSUE 9:
+    promoted from informational — overlap wins must not silently
+    regress). The fraction comes from the warmup-window device capture,
+    which is noisier than the compile-determined ratchets, so the guard
+    allows ``tol`` relative plus ``abs_tol`` absolute slack (a
+    zero-comms single-device family must not flag on measurement dust)
+    and a new low can tighten the baseline by at most half per round
+    (one lucky capture window must not set an unreachable floor).
+    Mirrors the census ratchet's opt-out: FFS_SKIP_EXPOSED=1 skips the
+    guard (the low-water value still records). Returns
+    (regression, baseline)."""
+    return _low_water_ratchet(
+        hist, key, "exposed_comms_frac", frac, tol, abs_tol=abs_tol,
+        skip=bool(os.environ.get("FFS_SKIP_EXPOSED")), max_drop=0.5)
+
+
 def hbm_ratchet(hist, key, peak_bytes, tol=0.02):
     """HBM-peak ratchet per workload family, the memory sibling of
     ``census_ratchet``: XLA's compiled memory analysis is also a
@@ -500,6 +528,7 @@ def main():
     protocol_notes = []
     census_regressions = []
     memory_regressions = []
+    exposed_regressions = []
     for name, build, iters in WORKLOADS:
         iters = 5 if on_cpu else iters
         windows = 1 if on_cpu else 3
@@ -577,18 +606,24 @@ def main():
         if mfu is not None:
             wl["mfu"] = round(mfu, 8)
         # measured exposed-comms fraction from the warmup-window device
-        # capture (ISSUE 8 satellite): the coordinate the comms-compute
-        # overlap direction ratchets — informational, recorded per
-        # workload into bench_history for cross-round comparison
+        # capture: since ISSUE 9 a downward-ratcheting GUARD (the
+        # overlap direction's coordinate — a strategy/executor change
+        # that re-exposes hidden comms fails the bench even when chip
+        # weather hides the samples/s cost). FFS_SKIP_EXPOSED=1 opts
+        # out, mirroring the census ratchet.
         tot = (devrep or {}).get("totals") or {}
         if tot.get("wall_s"):
-            wl["exposed_comms_frac"] = round(
-                tot.get("exposed_comms_s", 0.0) / tot["wall_s"], 4)
+            frac = round(tot.get("exposed_comms_s", 0.0) / tot["wall_s"], 4)
+            wl["exposed_comms_frac"] = frac
+            ereg, ebase = exposed_ratchet(hist, key, frac)
+            if ereg:
+                exposed_regressions.append(
+                    f"{name}: exposed_comms_frac {frac:.4f} vs recorded "
+                    f"best {ebase:.4f}")
         ent = hist.get(key)
         if isinstance(ent, dict):
             ent.update({k: wl[k] for k in
-                        ("step_time_p50", "step_time_p99", "mfu",
-                         "exposed_comms_frac")
+                        ("step_time_p50", "step_time_p99", "mfu")
                         if k in wl})
         if name == "bert_proxy":
             result.update({
@@ -616,6 +651,8 @@ def main():
         result["census_regressions"] = census_regressions
     if memory_regressions:
         result["memory_regressions"] = memory_regressions
+    if exposed_regressions:
+        result["exposed_regressions"] = exposed_regressions
     if protocol_notes:
         result["protocol_change"] = ("vs_baseline spans protocols — " +
                                      "; ".join(protocol_notes))

@@ -257,8 +257,58 @@ def _producer_spec(node, ctx):
 class CollectiveInferencePass:
     name = "collective-inference"
 
+    # Bucketed reduce-scatter note (ISSUE 9): under the comms-compute
+    # overlap structuring the ONE per-leaf grad reduce-scatter becomes N
+    # size-targeted bucket collectives issued in reverse-backward order.
+    # The inference above and the emitted census both aggregate BYTES per
+    # kind, so N bucket collectives summing to the unbucketed payload
+    # diff clean by construction (counts may differ; bytes must not) —
+    # asserted by tests/test_overlap.py::TestFflint.
+
+    # chosen-but-sync strategies whose priced collectives exceed this
+    # share of the op's total time get the FFL207 INFO when a
+    # latency-hiding '_ovl' twin was enumerated and rejected
+    OVL_EXPOSED_SHARE = 0.2
+
+    def _overlap_rejections(self, ctx) -> List[Diagnostic]:
+        """FFL207 (INFO): the search enumerated a latency-hiding '_ovl'
+        twin for an op, rejected it, and the chosen candidate still
+        prices a large exposed-collective share — either the rejection
+        is justified (tiny sync, launch overhead dominates) or the
+        hiding window is underpriced; the search trace's overlap sweep
+        says which."""
+        ff = ctx.ff
+        if ff is None or not isinstance(getattr(ff, "search_info", None),
+                                        dict):
+            return []
+        ops = (ff.search_info.get("search_trace") or {}).get("ops") or []
+        out: List[Diagnostic] = []
+        for oj in ops:
+            chosen_name = oj.get("chosen") or ""
+            if "_ovl" in chosen_name:
+                continue
+            cands = oj.get("candidates") or []
+            if not any("_ovl" in (c.get("choice") or "") for c in cands):
+                continue  # no twin enumerated — nothing was rejected
+            chosen = next((c for c in cands if c.get("chosen")), None)
+            terms = (chosen or {}).get("terms") or {}
+            total = terms.get("total_s") or 0.0
+            coll = terms.get("collective_s") or 0.0
+            if total > 0 and coll / total > self.OVL_EXPOSED_SHARE:
+                out.append(info(
+                    "FFL207",
+                    f"'{chosen_name}' prices {coll / total:.0%} of op time "
+                    f"as exposed collectives while a latency-hiding "
+                    f"'_ovl' twin was enumerated but rejected",
+                    op=oj.get("name"),
+                    hint="read the search trace's overlap sweep for this "
+                         "op — if the hiding window is underpriced the "
+                         "search leaves comms-compute overlap unused"))
+        return out
+
     def run(self, ctx) -> List[Diagnostic]:
         diags: List[Diagnostic] = []
+        diags.extend(self._overlap_rejections(ctx))
         inferred = infer_strategy_collectives(ctx)
         priced: Optional[Dict[str, float]] = None
         try:

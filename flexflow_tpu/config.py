@@ -108,6 +108,19 @@ class FFConfig:
     # per choice) and otherwise engages at data degree >= 4; 'on'/'off'
     # force it. Training-only; the pipeline executor keeps plain sync.
     weight_update_sharding: str = "auto"
+    # comms-compute overlap (ISSUE 9): the WUS gradient reduce-scatter
+    # issues as size-targeted bucketed async collectives in
+    # reverse-backward order (structured so XLA's async collectives hide
+    # them under remaining backward compute), and the next step's bf16
+    # param all-gathers prefetch under the optimizer fusion tail.
+    # 'auto' follows the searched value: overlap engages when the native
+    # DP picked '_ovl' choice twins (latency hiding is a priced strategy
+    # dimension, not an executor flag) and the bucket size is the
+    # byte-weighted winner of the searched bucket sweep; heuristic
+    # (non-searched) strategies engage whenever WUS does, at 4 MB.
+    # An explicit N forces N-MB buckets; '0'/'off' disables both the
+    # executor structuring and the search dimension.
+    overlap_bucket_mb: str = "auto"
     # fflint static verification at compile time (flexflow_tpu/analysis):
     # "off" skips it, "warn" prints the report, "error" additionally
     # raises when any ERROR-severity diagnostic fires (illegal sharding
@@ -261,6 +274,16 @@ class FFConfig:
                 self.conv_compute_layout = v
             elif a == "--disable-conv-bn-fold":
                 self.fold_conv_bn = False
+            elif a == "--overlap-bucket-mb":
+                v = take().lower()
+                if v not in ("auto", "off"):
+                    try:
+                        int(v)
+                    except ValueError:
+                        raise ValueError(
+                            f"--overlap-bucket-mb expects auto|off|N (MB), "
+                            f"got {v!r}") from None
+                self.overlap_bucket_mb = v
             elif a == "--weight-update-sharding":
                 v = take().lower()
                 if v not in ("auto", "on", "off"):

@@ -68,6 +68,9 @@ class GraphExecutor:
         final_is_softmax: bool = False,
         fold_conv_bn: bool = True,
         weight_update_sharding: bool = False,
+        wus_ops: Optional[set] = None,
+        overlap_grad_sync: bool = False,
+        overlap_bucket_bytes: int = 4 << 20,
     ):
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
@@ -103,6 +106,22 @@ class GraphExecutor:
         # instead of total params. Only meaningful with a data degree > 1.
         self.weight_update_sharding = bool(
             weight_update_sharding and self._data_degree() > 1)
+        # per-op WUS granularity: when the search picked "_wus" choices
+        # per op, only those ops' params/state shard — the rest keep the
+        # plain all-reduce sync, closing the priced-vs-emitted gap on
+        # mixed strategies. None = every eligible op (forced/heuristic).
+        self.wus_ops = set(wus_ops) if wus_ops is not None else None
+        # comms-compute overlap: the WUS gradient sync issues as
+        # size-targeted bucketed async reduce-scatters in reverse-
+        # backward order (each bucket's collective depends only on its
+        # own grads plus the previous bucket's issue, so XLA's async
+        # collective scheduler hides it under the remaining backward
+        # compute), and the next step's bf16 param all-gathers chain in
+        # forward order under the optimizer fusion tail. Identity on
+        # values — bit-for-bit parity with the synchronous sync.
+        self.grad_overlap = bool(overlap_grad_sync
+                                 and self.weight_update_sharding)
+        self.overlap_bucket_bytes = max(1, int(overlap_bucket_bytes))
         self._by_name = {n.op.name: n for n in nodes}
         self._jit_train = None
         self._jit_eval = None
@@ -129,6 +148,8 @@ class GraphExecutor:
         a model-sharded kernel shards 2-D (model x data)."""
         if not self.weight_update_sharding:
             return None
+        if self.wus_ops is not None and op_name not in self.wus_ops:
+            return None  # the search chose plain sync for this op
         node = self._by_name.get(op_name)
         if node is None:
             return None
@@ -162,9 +183,22 @@ class GraphExecutor:
         this turns the data-axis gradient psum GSPMD would emit as an
         all-reduce into a reduce-scatter (each chip keeps only its shard
         of the summed gradient); applied to the updated params/moments it
-        pins the shard layout through the optimizer fusion."""
+        pins the shard layout through the optimizer fusion.
+
+        Under ``grad_overlap`` the constraints apply bucket by bucket in
+        reverse-backward order (``_chain_constrained``): each bucket's
+        reduce-scatter depends only on its own grads plus the previous
+        bucket's issue, so XLA's async collective machinery hides it
+        under the remaining backward compute instead of sinking one
+        combined sync to the end of the step."""
         if not self.weight_update_sharding:
             return tree
+        if self.grad_overlap:
+            leaves = self._collect_spec_leaves(tree, self.wus_spec)
+            if not leaves:
+                return tree
+            return self._chain_constrained(
+                tree, leaves, self._bucket_order(leaves, reverse=True))
 
         def leaf(path, x):
             if len(path) < 2 or not hasattr(x, "shape"):
@@ -178,13 +212,109 @@ class GraphExecutor:
 
         return jax.tree_util.tree_map_with_path(leaf, tree)
 
+    # ---- bucketed async constraint chaining (comms-compute overlap) -------
+    def _collect_spec_leaves(self, tree, spec_fn):
+        """{(op name, param name): (leaf, spec)} for every float leaf of
+        a params-shaped tree where ``spec_fn(op, pname, shape)`` returns
+        a PartitionSpec (None = leave alone)."""
+        out: Dict[Tuple[str, str], Tuple[jax.Array, P]] = {}
+
+        def leaf(path, x):
+            if len(path) >= 2 and hasattr(x, "shape"):
+                op_name = getattr(path[-2], "key", None)
+                pname = getattr(path[-1], "key", None)
+                spec = spec_fn(op_name, pname, x.shape)
+                if spec is not None:
+                    out[(op_name, pname)] = (x, spec)
+            return x
+
+        jax.tree_util.tree_map_with_path(leaf, tree)
+        return out
+
+    def _bucket_order(self, leaves, reverse: bool):
+        """Leaf keys in graph-topological op order (``reverse=True`` for
+        the backward-completion order the gradient buckets follow)."""
+        by_op: Dict[str, list] = {}
+        for k in leaves:
+            by_op.setdefault(k[0], []).append(k)
+        order = []
+        for node in (reversed(self.nodes) if reverse else self.nodes):
+            order.extend(by_op.pop(node.op.name, ()))
+        for rest in by_op.values():  # unknown ops: stable tail
+            order.extend(rest)
+        return order
+
+    def _chain_constrained(self, tree, leaves, order):
+        """Apply sharding constraints to ``leaves`` in size-targeted
+        buckets (``overlap_bucket_bytes`` of payload each), chaining
+        consecutive buckets through ``lax.optimization_barrier``: bucket
+        k's constraint inputs depend on one of bucket k-1's constrained
+        outputs, so the lowered collectives issue in bucket order — the
+        structure XLA's async collective scheduler needs to hide each
+        bucket under the compute still running when it fires. The
+        barrier is the identity on values, so this path is bit-for-bit
+        identical to the unchained constraints (tests/test_overlap.py).
+        """
+        buckets, cur, size = [], [], 0
+        for key in order:
+            x, _ = leaves[key]
+            cur.append(key)
+            size += int(x.size) * x.dtype.itemsize
+            if size >= self.overlap_bucket_bytes:
+                buckets.append(cur)
+                cur, size = [], 0
+        if cur:
+            buckets.append(cur)
+        done: Dict[Tuple[str, str], jax.Array] = {}
+        prev = None
+        for bucket in buckets:
+            vals = [leaves[k][0] for k in bucket]
+            if prev is not None:
+                chained = jax.lax.optimization_barrier(tuple(vals) + (prev,))
+                vals = list(chained[:-1])
+            vals = [
+                jax.lax.with_sharding_constraint(
+                    v, NamedSharding(self.mesh, leaves[k][1]))
+                for k, v in zip(bucket, vals)
+            ]
+            prev = vals[0]
+            done.update(zip(bucket, vals))
+
+        def replace(path, x):
+            if len(path) >= 2:
+                k = (getattr(path[-2], "key", None),
+                     getattr(path[-1], "key", None))
+                if k in done:
+                    return done[k]
+            return x
+
+        return jax.tree_util.tree_map_with_path(replace, tree)
+
     def _constrain_compute(self, tree):
         """Constrain a params-shaped tree onto the strategy (compute)
         specs — the all-gather over the data axes that rebuilds the next
         step's replicated bf16 working copy from the WUS shards, fused
-        into the optimizer update."""
+        into the optimizer update.
+
+        Under ``grad_overlap`` the gathers chain in FORWARD op order
+        (``_chain_constrained``): the first layers' compute params — the
+        ones the next step's forward needs first — prefetch under the
+        optimizer fusion tail while later leaves' update math still
+        runs."""
         if not self.weight_update_sharding:
             return tree
+        if self.grad_overlap:
+            def spec_fn(op_name, pname, shape):
+                node = self._by_name.get(op_name)
+                if node is None:
+                    return None
+                return node.param_specs.get(pname, P())
+
+            leaves = self._collect_spec_leaves(tree, spec_fn)
+            if not leaves:
+                return tree
+            return self._chain_constrained(
+                tree, leaves, self._bucket_order(leaves, reverse=False))
 
         def leaf(path, x):
             if len(path) < 2 or not hasattr(x, "shape"):

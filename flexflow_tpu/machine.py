@@ -112,6 +112,11 @@ class MachineSpec:
     # aggregates; measured per-op tables still override the analytic model.
     conv_efficiency: float = 0.35
     min_op_time: float = 5e-7     # per-kernel dispatch overhead (seconds)
+    # per-bucket launch cost of an async (bucketed) collective: the
+    # start/done pair XLA schedules around a hidden collective still
+    # costs a dispatch plus the ring's first-hop latency — the '_ovl'
+    # latency-hiding pricing charges it once per bucket
+    collective_launch_overhead: float = 2e-6
     # Arbitrary inter-slice fabric (the reference NetworkedMachineModel's
     # role, simulator.h:515 + network.cc ECMP routing, re-expressed
     # TPU-first): explicit slice-pair links [(i, j, bytes_per_s), ...].
@@ -159,6 +164,7 @@ class MachineSpec:
         "mxu_efficiency": ("mxu_efficiency", float),
         "conv_efficiency": ("conv_efficiency", float),
         "min_op_time": ("min_op_time", float),
+        "collective_launch_overhead": ("collective_launch_overhead", float),
         # per-slice ICI torus extents: JSON list or "4 2" in key=value form
         "torus": ("torus",
                   lambda v: tuple(int(x) for x in

@@ -718,10 +718,47 @@ class FFModel:
         else:
             wus = searched_wus if searched else data_deg >= 4
         self.wus_enabled = wus
+        # per-op WUS granularity: a searched strategy picks '_wus' per
+        # op; under 'auto' the executor honors each op's choice instead
+        # of applying WUS globally — the ops the DP left on plain
+        # all-reduce keep it, closing the priced-vs-emitted gap on mixed
+        # strategies. Forced 'on' (and heuristic strategies) stay global.
+        wus_ops = None
+        if wus and wus_mode == "auto" and searched and searched_wus:
+            wus_ops = {
+                n.op.name for n in nodes
+                if "_wus" in (getattr((self.strategy or {}).get(n.op.guid),
+                                      "choice", None) or "")}
+        # comms-compute overlap (ISSUE 9): bucketed async grad reduce-
+        # scatter + prefetched compute-param all-gathers. 'auto' follows
+        # the search: overlap engages when the DP picked '_ovl' choice
+        # twins (with the searched bucket size), or whenever WUS engages
+        # on heuristic strategies (4 MB default); explicit N forces
+        # N-MB buckets; '0'/'off' disables.
+        ovl_raw = str(getattr(cfg, "overlap_bucket_mb", "auto")).lower()
+        searched_ovl = searched and any(
+            "_ovl" in (getattr(st, "choice", None) or "")
+            for st in (self.strategy or {}).values())
+        searched_bucket = ((self.search_info or {}).get("overlap") or {}).get(
+            "bucket_mb") if searched else None
+        if ovl_raw in ("0", "off"):
+            overlap, bucket_mb = False, 4.0
+        elif ovl_raw == "auto":
+            overlap = searched_ovl if searched else wus
+            bucket_mb = float(searched_bucket or 4.0)
+        else:
+            bucket_mb = float(int(ovl_raw))
+            overlap = bucket_mb > 0
+        self.overlap_enabled = bool(overlap and wus)
         exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
                            final_is_softmax=self._final_is_softmax,
                            fold_conv_bn=cfg.fold_conv_bn,
-                           weight_update_sharding=wus)
+                           weight_update_sharding=wus,
+                           wus_ops=wus_ops,
+                           overlap_grad_sync=overlap,
+                           # MB (1e6), matching the native bucket sweep's
+                           # wire-byte unit (ffs_strategy.hpp kOvlBucketMB)
+                           overlap_bucket_bytes=int(bucket_mb * 1e6))
         # conv-family execution layout (flexflow_tpu/layout.py): NCHW stays
         # the API/PCG boundary, but on TPU the conv family computes
         # channels-last with boundary transposes hoisted to chain edges.
@@ -1310,7 +1347,10 @@ class FFModel:
                            data_axes=full.data_axes,
                            final_is_softmax=self._final_is_softmax,
                            fold_conv_bn=full.fold_conv_bn,
-                           weight_update_sharding=full.weight_update_sharding)
+                           weight_update_sharding=full.weight_update_sharding,
+                           wus_ops=full.wus_ops,
+                           overlap_grad_sync=full.grad_overlap,
+                           overlap_bucket_bytes=full.overlap_bucket_bytes)
         ex.comp_mode = full.comp_mode
         self._seq_execs[bucket] = ex
         return ex

@@ -269,11 +269,22 @@ def attribute_steps(device_events: List[Dict[str, Any]],
             exposed_comms_s=comms_s - overlapped_s,
             host_s=host_s,
             idle_s=max(wall_s - busy_s, 0.0),
-            per_kind={k: dict(
-                time_s=interval_total(merge_intervals(v)) / 1e6,
-                count=kind_count[k]) for k, v in kind_iv.items()},
+            # per-kind hidden/exposed split (ISSUE 9): a kind's hidden
+            # seconds are its intervals under the compute union — the
+            # measured counterpart of the simulator's per-choice hidden
+            # term, so the merged report can show WHERE overlap lands
+            per_kind={k: _kind_entry(v, kind_count[k], compute_u)
+                      for k, v in kind_iv.items()},
         ))
     return rows
+
+
+def _kind_entry(iv, count, compute_u):
+    u = merge_intervals(iv)
+    t = interval_total(u) / 1e6
+    hidden = intersect_total(u, compute_u) / 1e6
+    return dict(time_s=t, count=count, overlapped_s=hidden,
+                exposed_s=t - hidden)
 
 
 def aggregate_attribution(per_step: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -288,11 +299,16 @@ def aggregate_attribution(per_step: List[Dict[str, Any]]) -> Dict[str, Any]:
         for k in totals:
             totals[k] += row[k]
         for kind, e in row["per_kind"].items():
-            c = coll.setdefault(kind, dict(time_s=0.0, count=0))
+            c = coll.setdefault(kind, dict(time_s=0.0, count=0,
+                                           overlapped_s=0.0, exposed_s=0.0))
             c["time_s"] += e["time_s"]
             c["count"] += e["count"]
+            c["overlapped_s"] += e.get("overlapped_s", 0.0)
+            c["exposed_s"] += e.get("exposed_s", e["time_s"])
     for c in coll.values():
         c["per_step_s"] = c["time_s"] / n if n else 0.0
+        c["exposed_per_step_s"] = c["exposed_s"] / n if n else 0.0
+        c["overlapped_per_step_s"] = c["overlapped_s"] / n if n else 0.0
     return dict(steps=n, totals=totals, collectives=coll)
 
 

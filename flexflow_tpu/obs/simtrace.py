@@ -63,6 +63,12 @@ def sim_lane_events(tasks: List[Dict[str, Any]],
         if t.get("collective"):
             args["collective"] = t["collective"]
             args["bytes"] = t.get("bytes", 0)
+        if t.get("hidden_s"):
+            # predicted-hidden interval (ISSUE 9): seconds of this comm
+            # task the simulator scheduled under busy compute — in the
+            # merged view, compare against the devtrace lanes' measured
+            # overlapped_comms_s to check the hiding actually landed
+            args["hidden_s"] = round(float(t["hidden_s"]), 9)
         events.append(dict(
             name=f"{label}:{kind}", ph="X", tid=tid,
             ts=round(t0_us + start * 1e6, 3),
@@ -83,7 +89,7 @@ def per_op_predicted(tasks: List[Dict[str, Any]]
             continue
         row = out.setdefault(int(node), dict(
             fwd_s=0.0, bwd_s=0.0, comm_s=0.0, gradsync_s=0.0,
-            collective_bytes=0.0))
+            hidden_s=0.0, collective_bytes=0.0))
         dur = max(0.0, float(t.get("finish", 0.0))
                   - float(t.get("start", 0.0)))
         kind = str(t.get("kind", ""))
@@ -93,6 +99,7 @@ def per_op_predicted(tasks: List[Dict[str, Any]]
             row["comm_s"] += dur
         elif kind == "gradsync":
             row["gradsync_s"] += dur
+        row["hidden_s"] += float(t.get("hidden_s", 0.0))
         if t.get("collective"):
             row["collective_bytes"] += float(t.get("bytes", 0.0))
     return out
@@ -151,6 +158,11 @@ def simtrace_report(ff, resp: Dict[str, Any],
             bwd_s=resp.get("bwd_time"),
             comm_s=resp.get("comm_time"),
             gradsync_s=resp.get("gradsync_time"),
+            # predicted comm seconds hidden under compute (the schedule's
+            # overlapped intervals + the '_ovl'/pipeline analytic hidden
+            # terms) — the predicted twin of the devtrace's measured
+            # overlapped_comms_s
+            hidden_comm_s=resp.get("hidden_comm_time"),
             memory_bytes=resp.get("memory"),
         ),
         search_predicted_s=(ff.search_info or {}).get("predicted_time")

@@ -144,19 +144,27 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
             state, outs, circ, in_stream, out_stream = carry
             # ---- input side: the microbatch entering stage 0 ----------
             if qsharded:
-                # advance the input stream one hop toward stage 0, then
-                # inject the locally-held microbatch t+idx when this
-                # stage owns it (owner h(m) = m // q injects m at tick
-                # m - h(m); stage 0 then reads microbatch t at tick t)
-                in_stream = jax.lax.ppermute(in_stream, axis, down)
-                m_in = t + idx
+                # double-buffered input stream (ISSUE 9): consume the
+                # value staged at the END of the previous tick, then
+                # advance the stream for tick t+1 — the hop's ppermute
+                # has no consumer inside this tick, so XLA's async
+                # collective scheduling overlaps it with the block
+                # compute instead of gating stage 0's feed on it (the
+                # simulator already priced the streams as bandwidth-only
+                # prefetch traffic; this makes the runtime match).
+                # Protocol: owner h(m) = m // q injects m at the end of
+                # tick m - h - 1 (h == 0 and m == 0 come from the
+                # pre-loop staging); stage 0 reads microbatch t at tick
+                # t, exactly as the synchronous stream delivered.
+                queue_feed = in_stream
+                nxt = jax.lax.ppermute(in_stream, axis, down)
+                m_in = t + 1 + idx
                 owned = jnp.logical_and(m_in >= idx * q,
                                         m_in < (idx + 1) * q)
                 li = jnp.clip(m_in - idx * q, 0, q - 1)
                 mine = jax.lax.dynamic_index_in_dim(xs, li, 0,
                                                     keepdims=False)
-                in_stream = jnp.where(owned, mine, in_stream)
-                queue_feed = in_stream
+                in_stream = jnp.where(owned, mine, nxt)
             else:
                 feed = jnp.clip(t, 0, M - 1)
                 queue_feed = jax.lax.dynamic_index_in_dim(
@@ -223,7 +231,20 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
             circ0 = xs  # replicated queue doubles as the round-0 feed
         else:
             circ0 = jnp.zeros((1,) + xs.shape[1:], xs.dtype)  # unused
-        carry = (z, outs, circ0, z, z)
+        if qsharded:
+            # pre-loop staging of the double-buffered input stream: the
+            # "end of tick -1" injection — stage 0 stages microbatch 0
+            # (and with q == 1, stage h stages its own microbatch h,
+            # which then rides h hops to arrive at tick h)
+            m0 = idx
+            owned0 = jnp.logical_and(m0 >= idx * q, m0 < (idx + 1) * q)
+            li0 = jnp.clip(m0 - idx * q, 0, q - 1)
+            in0 = jnp.where(owned0,
+                            jax.lax.dynamic_index_in_dim(xs, li0, 0,
+                                                         keepdims=False), z)
+        else:
+            in0 = z
+        carry = (z, outs, circ0, in0, z)
         _, outs, _, _, out_stream = jax.lax.fori_loop(0, ticks, tick, carry)
         if qsharded:
             def drain_tick(j, carry):

@@ -20,6 +20,17 @@ gradients reduce-scatter over the data axes onto a
 P('pipe', ..., 'data') master/optimizer-state layout, and the next
 step's compute params all-gather back inside the optimizer fusion —
 the same invariants as the flat executor (tests/test_wus.py).
+
+Comms-compute overlap at pp > 1 (ISSUE 9): the sharded microbatch
+queue's input stream is double-buffered inside pipeline_spmd (tick
+t+1's hop issues while tick t's block runs), matching the simulator's
+bandwidth-only stream pricing. The stacked body gradient sync stays
+unbucketed — it is ONE stacked reduce-scatter whose hiding window is
+the optimizer-fusion tail, which simulate_pipeline's '_ovl' pricing
+models; the per-op bucket partition applies to head/tail ops through
+the base executor. Per-op '_wus' granularity (wus_ops) likewise gates
+head/tail leaves; the body shards all-or-nothing with
+weight_update_sharding.
 """
 
 from __future__ import annotations

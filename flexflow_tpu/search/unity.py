@@ -140,6 +140,11 @@ def machine_to_json(spec, num_devices: int,
         # instead of assuming matmul-grade MXU utilization
         conv_efficiency=getattr(spec, "conv_efficiency", 0.35),
         min_op_time=getattr(spec, "min_op_time", 5e-7),
+        # per-bucket launch cost of the bucketed async gradient sync —
+        # the term that stops the '_ovl' bucket sweep from degenerating
+        # to infinitely many tiny buckets (ffs_machine.hpp)
+        collective_launch_overhead=getattr(spec, "collective_launch_overhead",
+                                           2e-6),
         # bf16 activations/grads under mixed precision: collectives move
         # half the nominal f32 bytes (ffs_machine.hpp comm_bytes_factor)
         comm_bytes_factor=comm_bytes_factor,
@@ -307,6 +312,13 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # (ffs_strategy.hpp); "off" removes them
             weight_update_sharding=getattr(config, "weight_update_sharding",
                                            "auto"),
+            # comms-compute overlap as a searched dimension: anything but
+            # off/0 enumerates the '_ovl' latency-hiding choice twins
+            # whose gradient sync is priced as bucketed async collectives
+            # hidden under remaining backward compute (ffs_strategy.hpp)
+            comm_overlap=("off" if str(getattr(
+                config, "overlap_bucket_mb", "auto")).lower() in ("0", "off")
+                else "auto"),
             # search provenance: per-mesh candidates + rejection reasons,
             # frontier-DP evolution, per-op candidate cost table
             # (--search-trace / FFS_SEARCH_TRACE; explain.py sets it too)
@@ -361,6 +373,10 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 rewrites=resp.get("rewrites", []))
     if resp.get("search_trace"):
         info["search_trace"] = resp["search_trace"]
+    if resp.get("overlap"):
+        # byte-weighted winning bucket size across the '_ovl' choices —
+        # the searched value --overlap-bucket-mb 'auto' follows
+        info["overlap"] = resp["overlap"]
     if resp.get("pipeline") and mesh_axes.get("pipe", 1) > 1:
         # the search picked a GPipe strategy: hand compile() what the
         # lowering onto pipeline_spmd needs (rewrites never fire together

@@ -134,22 +134,31 @@ def simulate_strategy(ff) -> Dict[str, Any]:
 
     nodes = ff.executor.nodes
     wus_on = bool(getattr(ff.executor, "weight_update_sharding", False))
+    wus_ops = getattr(ff.executor, "wus_ops", None)
+    ovl_on = bool(getattr(ff.executor, "grad_overlap", False))
     assignment = {}
     for node in nodes:
         st = (ff.strategy or {}).get(node.op.guid)
         choice = getattr(st, "choice", None)
         if choice is None:
             choice = _infer_choice(node, st)
-        # replay what the executor EXECUTES, not what the DP picked:
-        # WUS applies globally at runtime (per-param by divisibility), so
-        # a searched strategy that mixed _wus and plain choices — or a
-        # forced --weight-update-sharding on/off — must replay uniformly
-        # or the priced-vs-emitted diff flags a correct model. The native
-        # side falls back to the base choice when an op spawns no twin.
-        if wus_on and "_wus" not in choice and node.op.params_elems():
+        # replay what the executor EXECUTES, not what the DP picked: the
+        # executor honors per-op "_wus" choices when the search supplied
+        # them (wus_ops) and applies WUS globally otherwise, and the
+        # bucketed-async overlap structuring ("_ovl") is an executor
+        # property — so the suffixes are normalized to the runtime
+        # state. The native side falls back along the suffix lattice
+        # when an op spawns no matching twin.
+        base = choice
+        for sfx in ("_ovl", "_wus"):
+            base = base.replace(sfx, "")
+        choice = base
+        op_wus = (wus_on and node.op.params_elems()
+                  and (wus_ops is None or node.op.name in wus_ops))
+        if op_wus:
             choice += "_wus"
-        elif not wus_on and "_wus" in choice:
-            choice = choice.replace("_wus", "")
+            if ovl_on:
+                choice += "_ovl"
         assignment[str(node.op.guid)] = choice
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
     req = dict(

@@ -44,6 +44,12 @@ struct MachineModel {
   // measured per-op costs still override everything.
   double conv_efficiency = 0.35;
   double min_op_time = 5e-7;     // floor per fused op (dispatch overhead)
+  // Per-collective launch cost of an async (bucketed) collective: the
+  // start/done pair XLA schedules around a hidden collective still costs
+  // a dispatch plus the ring's first-hop latency. The latency-hiding
+  // "_ovl" pricing charges this once per bucket, which is what stops the
+  // bucket sweep from degenerating to infinitely many tiny buckets.
+  double collective_launch_overhead = 2e-6;
   // Collective payloads relative to the graph's nominal dtype: under the
   // r4 mixed-precision regime activations AND gradients move in bf16
   // while tensors are declared f32, so every collective's bytes halve
@@ -151,6 +157,8 @@ struct MachineModel {
     m.mxu_efficiency = j.get("mxu_efficiency").as_double(m.mxu_efficiency);
     m.conv_efficiency = j.get("conv_efficiency").as_double(m.conv_efficiency);
     m.min_op_time = j.get("min_op_time").as_double(m.min_op_time);
+    m.collective_launch_overhead = j.get("collective_launch_overhead")
+                                       .as_double(m.collective_launch_overhead);
     m.comm_bytes_factor =
         j.get("comm_bytes_factor").as_double(m.comm_bytes_factor);
     const Json& tj = j.get("torus");

@@ -68,6 +68,8 @@ struct SearchConfig {
                                     // (reference --disable-fusion)
   bool enable_wus = true;           // weight-update-sharding choice variants
                                     // (--weight-update-sharding != off)
+  bool enable_overlap = true;       // comms-compute-overlap "_ovl" choice
+                                    // variants (--overlap-bucket-mb != 0)
   bool emit_trace = false;          // structured search-trace emission
                                     // (search provenance; explain.py /
                                     // obs .searchtrace.json artifact)
@@ -101,6 +103,9 @@ struct SearchConfig {
     // "auto"/"on" enumerate the _wus twins (the DP picks per mesh);
     // "off" removes the dimension entirely
     c.enable_wus = j.get("weight_update_sharding").as_string() != "off";
+    // "auto"/"on"/explicit-bucket enumerate the "_ovl" latency-hiding
+    // twins (the DP picks per op); "off" removes the dimension
+    c.enable_overlap = j.get("comm_overlap").as_string() != "off";
     c.emit_trace = j.get("emit_search_trace").as_bool(false);
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
@@ -134,7 +139,10 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                 // WUS twins exist on pipe meshes too: the
                                 // pipeline executor reduce-scatters the
                                 // stacked body grads over the data axes
-                                cfg.enable_wus && cfg.training);
+                                cfg.enable_wus && cfg.training,
+                                // "_ovl" latency-hiding twins: only
+                                // meaningful in training (gradient sync)
+                                cfg.enable_overlap && cfg.training);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -789,7 +797,51 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
   terms.set("collective_s", Json(base.comm + base.gradsync));
   terms.set("opt_state_s", Json(update_s));
   terms.set("total_s", Json(full.total()));
+  if (c.ovl)
+    // comm seconds the latency-hiding pricing hid under the op's
+    // backward (+ optimizer tail) — the predicted-hidden column
+    terms.set("hidden_s", Json(full.gradsync_hidden));
   cj.set("terms", terms);
+  if (c.ovl) {
+    // the bucket sweep behind the committed "_ovl" price: every
+    // size-targeted candidate's exposed seconds, so the trace shows WHY
+    // this bucket size won (ISSUE 9 satellite — sweep provenance)
+    Json ov = Json::object();
+    ov.set("bucket_mb", Json(full.ovl_bucket_mb));
+    ov.set("buckets", Json((int64_t)full.ovl_buckets));
+    ov.set("hidden_s", Json(full.gradsync_hidden));
+    Json sweep = Json::array();
+    {
+      // reprice the sync + hiding window exactly as node_cost does
+      Choice sync_c = c;
+      sync_c.ovl = false;
+      NodeCost base_sync = node_cost(n, sync_c, mesh, m, cfg.training,
+                                     measured);
+      double hide = base_sync.bwd;
+      if (n.param_bytes() > 0) {
+        double upd = detail::sharded_param_bytes(n, c, mesh) *
+                     (3.0 + 2.0 * cfg.opt_state_factor) / m.hbm_bw;
+        if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
+        hide += upd;
+      }
+      double wire = c.gradsync_bytes * m.comm_bytes_factor;
+      for (int bi = 0; bi < kOvlBucketCount; ++bi) {
+        double mb = kOvlBucketMB[bi];
+        int B = std::max(
+            1, (int)std::ceil(wire / (mb * 1e6)));
+        double exp = std::max(base_sync.gradsync / B,
+                              base_sync.gradsync - hide) +
+                     B * m.collective_launch_overhead;
+        Json row = Json::object();
+        row.set("bucket_mb", Json(mb));
+        row.set("buckets", Json((int64_t)B));
+        row.set("exposed_s", Json(exp));
+        sweep.push_back(std::move(row));
+      }
+    }
+    ov.set("sweep", sweep);
+    cj.set("overlap", ov);
+  }
   Json mem = Json::object();
   mem.set("param_bytes", Json(param_b));
   mem.set("opt_state_bytes", Json(std::max(0.0, pmem - param_b)));
@@ -1191,6 +1243,36 @@ Json optimize(const Json& req) {
     ops.set(std::to_string(g.nodes[i].guid), oj);
   }
   out.set("ops", ops);
+  // searched overlap summary: the byte-weighted winning bucket size
+  // across the assignment's "_ovl" choices — the value the executor's
+  // --overlap-bucket-mb 'auto' follows (per-op buckets agree in
+  // practice; bytes break the tie when they don't)
+  {
+    MachineModel mt = m;
+    mt.assign_torus(best.mesh.dp, best.mesh.mp, best.mesh.sp, best.mesh.ep);
+    std::map<double, double> by_bucket;
+    int ovl_ops = 0;
+    for (size_t i = 0; i < g.nodes.size(); ++i) {
+      const Choice& c = best.choices[i][best.assign[i]];
+      if (!c.ovl || c.gradsync_bytes <= 0) continue;
+      NodeCost nc = node_cost(g.nodes[i], c, best.mesh, mt, cfg.training,
+                              &measured, cfg.opt_state_factor);
+      by_bucket[nc.ovl_bucket_mb] += c.gradsync_bytes;
+      ++ovl_ops;
+    }
+    if (ovl_ops > 0) {
+      double top_mb = 0, top_bytes = -1;
+      for (const auto& kv : by_bucket)
+        if (kv.second > top_bytes) {
+          top_bytes = kv.second;
+          top_mb = kv.first;
+        }
+      Json ovj = Json::object();
+      ovj.set("bucket_mb", Json(top_mb));
+      ovj.set("ops", Json((int64_t)ovl_ops));
+      out.set("overlap", ovj);
+    }
+  }
   // rewrite trace: Python replays this on its OpNode graph
   Json rewrites = Json::array();
   for (const RewriteTraceEntry& e : best_trace) {
@@ -1277,15 +1359,35 @@ Json simulate_only(const Json& req) {
     };
     const Choice* pick = find(want);
     if (pick == nullptr) {
-      // WUS-suffix fallback both ways: a heuristic replay may ask for a
-      // "_wus" twin an op doesn't spawn (no gradsync), and a stale
-      // strategy file may lack the suffix a wus-enabled run expects
-      const std::string sfx = "_wus";
-      if (want.size() > sfx.size() &&
-          want.compare(want.size() - sfx.size(), sfx.size(), sfx) == 0)
-        pick = find(want.substr(0, want.size() - sfx.size()));
-      else
-        pick = find(want + sfx);
+      // suffix fallback both ways for the "_wus"/"_ovl" twins: a
+      // heuristic replay may ask for a twin an op doesn't spawn (no
+      // gradsync), and a stale strategy file may lack the suffixes an
+      // enabled run expects. Canonical order is base[+_wus][+_ovl].
+      // Candidates walk the suffix lattice nearest the REQUESTED
+      // suffixes first, toggling "_ovl" (a pure latency-hiding pricing
+      // delta) before "_wus" (which also moves optimizer-state memory
+      // and the update triad) — so e.g. a plain "dp_ovl" request never
+      // silently picks up WUS pricing while "dp" is available.
+      auto strip = [](std::string s, const char* sfx) {
+        size_t n = strlen(sfx);
+        if (s.size() > n && s.compare(s.size() - n, n, sfx) == 0)
+          s.erase(s.size() - n);
+        return s;
+      };
+      std::string base = strip(strip(want, "_ovl"), "_wus");
+      const bool has_wus = want.find("_wus") != std::string::npos;
+      const bool has_ovl = want.find("_ovl") != std::string::npos;
+      auto name_of = [&](bool w, bool o) {
+        return base + (w ? "_wus" : "") + (o ? "_ovl" : "");
+      };
+      const std::string cands[] = {name_of(has_wus, !has_ovl),
+                                   name_of(!has_wus, has_ovl),
+                                   name_of(!has_wus, !has_ovl)};
+      for (const std::string& cand : cands) {
+        if (cand == want) continue;
+        pick = find(cand);
+        if (pick != nullptr) break;
+      }
     }
     if (pick == nullptr)
       throw std::runtime_error("unknown/illegal choice '" + want +
@@ -1326,6 +1428,10 @@ Json simulate_only(const Json& req) {
   out.set("bwd_time", Json(r.bwd_time));
   out.set("comm_time", Json(r.comm_time));
   out.set("gradsync_time", Json(r.gradsync_time));
+  // predicted comm seconds hidden under compute (the schedule's
+  // overlapped intervals + the pipeline/"_ovl" analytic hidden terms) —
+  // the predicted twin of devtrace's measured overlapped_comms_s
+  out.set("hidden_comm_time", Json(r.hidden_comm_time));
   Json tasks = Json::array();
   for (const SimTask& t : r.tasks) {
     Json tj = Json::object();
@@ -1338,6 +1444,8 @@ Json simulate_only(const Json& req) {
       tj.set("collective", Json(t.collective));
       tj.set("bytes", Json(t.bytes));
     }
+    if (t.hidden > 0)
+      tj.set("hidden_s", Json(t.hidden));
     tasks.push_back(tj);
   }
   out.set("tasks", tasks);

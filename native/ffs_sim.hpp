@@ -39,11 +39,19 @@ struct SimTask {
   double bytes = 0;        // global payload bytes priced
   // filled by the scheduler:
   double start = 0, finish = 0;
+  // seconds of this comm-stream task that ran while the compute stream
+  // was busy — the predicted-hidden interval the simtrace sim: lanes
+  // surface (filled by the post-schedule pass; 0 for compute tasks)
+  double hidden = 0;
 };
 
 struct SimResult {
   double iteration_time = 0;
   double fwd_time = 0, bwd_time = 0, comm_time = 0, gradsync_time = 0;
+  // total comm/gradsync seconds hidden under compute in the schedule
+  // (plus the pipeline/"_ovl" analytic hidden terms) — the predicted
+  // twin of the devtrace's measured overlapped_comms_s
+  double hidden_comm_time = 0;
   double memory = 0;  // per-device bytes
   std::vector<SimTask> tasks;  // schedule (for --taskgraph export)
 };
@@ -210,7 +218,17 @@ class TaskgraphSimulator {
         const Choice& c = assign[i];
         if (c.gradsync_bytes > 0 && c.gradsync_k > 1) {
           std::vector<int> deps = {bwd_id[i]};
-          if (!overlap_ && last_bwd >= 0) deps.push_back(last_bwd);
+          // "_ovl": the executor issues this op's sync as bucketed async
+          // collectives the moment its grads exist — never serialized
+          // behind the whole backward, even under the no-overlap default
+          // schedule. The per-bucket launch overhead is charged on the
+          // task (hiding is not free); the hiding itself emerges from
+          // the two-stream list schedule and is reported by the
+          // post-schedule hidden pass below.
+          if (!c.ovl && !overlap_ && last_bwd >= 0)
+            deps.push_back(last_bwd);
+          double wire = c.gradsync_bytes * m_.comm_bytes_factor;
+          double bwd_dur = tasks[bwd_id[i]].duration;
           if (c.wus) {
             // WUS: reduce-scatter the gradients (the RS half keeps the
             // census 'allreduce' bucket — XLA's AR decomposition), then
@@ -218,11 +236,14 @@ class TaskgraphSimulator {
             // tasks so the collective census diff sees both kinds.
             double t1 = m_.wus_rs_time(c.gradsync_bytes, c.gradsync_k,
                                        spans, kData);
+            double t2 = m_.wus_ag_time(c.gradsync_bytes, c.gradsync_k,
+                                       spans, kData);
+            if (c.ovl)
+              t1 += overlap_price(m_, t1 + t2, wire, bwd_dur).buckets *
+                    m_.collective_launch_overhead;
             SimTask rs{SimTask::Kind::GradSync, (int)i, t1, deps,
                        "allreduce", c.gradsync_bytes};
             int rs_id = add(std::move(rs));
-            double t2 = m_.wus_ag_time(c.gradsync_bytes, c.gradsync_k,
-                                       spans, kData);
             SimTask ag{SimTask::Kind::GradSync, (int)i, t2, {rs_id},
                        "allgather", c.gradsync_bytes};
             sync_ids.push_back(add(std::move(ag)));
@@ -230,6 +251,9 @@ class TaskgraphSimulator {
           } else {
             double t = m_.hier_allreduce_time(c.gradsync_bytes,
                                               c.gradsync_k, spans, kData);
+            if (c.ovl)
+              t += overlap_price(m_, t, wire, bwd_dur).buckets *
+                   m_.collective_launch_overhead;
             SimTask st{SimTask::Kind::GradSync, (int)i, t, deps,
                        "allreduce", c.gradsync_bytes};
             sync_ids.push_back(add(std::move(st)));
@@ -277,6 +301,31 @@ class TaskgraphSimulator {
       t.finish = t.start + t.duration;
       stream = t.finish;
       makespan = std::max(makespan, t.finish);
+    }
+    // post-schedule hidden pass: seconds of each comm-stream task that
+    // ran while the compute stream was busy — the predicted hidden
+    // intervals (compute tasks are sequential on one stream, so their
+    // [start, finish) spans are disjoint and sorted)
+    {
+      std::vector<std::pair<double, double>> busy;
+      for (const auto& t : tasks)
+        if (t.kind != SimTask::Kind::Comm &&
+            t.kind != SimTask::Kind::GradSync && t.duration > 0)
+          busy.push_back({t.start, t.finish});
+      size_t lo = 0;
+      for (auto& t : tasks) {
+        if (t.kind != SimTask::Kind::Comm &&
+            t.kind != SimTask::Kind::GradSync)
+          continue;
+        double h = 0;
+        while (lo < busy.size() && busy[lo].second <= t.start) ++lo;
+        for (size_t b = lo; b < busy.size() && busy[b].first < t.finish;
+             ++b)
+          h += std::max(0.0, std::min(t.finish, busy[b].second) -
+                                 std::max(t.start, busy[b].first));
+        t.hidden = h;
+        res.hidden_comm_time += h;
+      }
     }
     res.iteration_time = makespan;
     if (measured_) {
@@ -351,7 +400,12 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   const bool qshard = shard_queue && pp > 0 && M % pp == 0;
   double fwd_body = 0, bwd_body = 0, fwd_edge = 0;
   double body_act = 0, body_param_mem = 0;
+  // body gradient-sync bytes, split by (wus, ovl): the "_ovl" groups
+  // price only the un-hidden tail of their sync (the stacked body grads
+  // finish with the last backward tick, so the hiding window is the
+  // optimizer-fusion tail, not backward compute)
   double body_gs_plain = 0, body_gs_wus = 0;
+  double body_gs_plain_ovl = 0, body_gs_wus_ovl = 0;
   int body_ops = 0;
   int gradsync_k = mesh.dp;
   double ht_time = 0, ht_param_mem = 0, ht_act = 0, ht_gradsync = 0;
@@ -381,7 +435,9 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
       body_param_mem += pmem;
       body_act += act;
       if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1)
-        (c.wus ? body_gs_wus : body_gs_plain) += c.gradsync_bytes;
+        (c.ovl ? (c.wus ? body_gs_wus_ovl : body_gs_plain_ovl)
+               : (c.wus ? body_gs_wus : body_gs_plain)) +=
+            c.gradsync_bytes;
       if (!is_view_op(n.type)) ++body_ops;
     } else {
       ht_time += nc.fwd + nc.bwd + nc.comm;
@@ -401,6 +457,14 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
                                     kData);
           add_task(SimTask::Kind::GradSync, (int)i, 0, "allreduce",
                    c.gradsync_bytes);
+        }
+        if (c.ovl) {
+          // head/tail op outside the pipeline: its bucketed async sync
+          // hides under the op's own backward compute, as in node_cost
+          OverlapPricing ov = overlap_price(
+              m, t, c.gradsync_bytes * m.comm_bytes_factor, nc.bwd);
+          res.hidden_comm_time += ov.hidden;
+          t = ov.exposed;
         }
         ht_gradsync += t;
       }
@@ -470,6 +534,12 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   if (training) {
     res.bwd_time = ticks * (tick_bwd + hop);
     res.iteration_time += res.bwd_time;
+    double upd_bw = m.hbm_bw;
+    if (measured != nullptr) {
+      auto it = measured->find("__update_bw__");
+      if (it != measured->end() && it->second > 0) upd_bw = it->second;
+    }
+    double upd_time = upd_bytes / upd_bw;
     if (mesh.dp > 1 && body_gs_plain > 0) {
       double t = m.hier_allreduce_time(body_gs_plain / pp, gradsync_k,
                                        spans, kData);
@@ -489,14 +559,42 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
       add_task(SimTask::Kind::GradSync, -1, t2, "allgather",
                body_gs_wus / pp);
     }
+    if (mesh.dp > 1 && body_gs_plain_ovl + body_gs_wus_ovl > 0) {
+      // "_ovl" body groups: the stacked body grads only finish with the
+      // last backward tick (grad accumulation over microbatches), so
+      // the hiding window is the optimizer-fusion tail — the update
+      // triad the WUS param all-gather prefetches under — not backward
+      // compute. Census bytes are recorded unchanged; only the priced
+      // exposed time shrinks.
+      double hide = upd_time;
+      if (body_gs_plain_ovl > 0) {
+        double t = m.hier_allreduce_time(body_gs_plain_ovl / pp,
+                                         gradsync_k, spans, kData);
+        OverlapPricing ov = overlap_price(
+            m, t, body_gs_plain_ovl / pp * m.comm_bytes_factor, hide);
+        hide = std::max(0.0, hide - ov.hidden);
+        res.gradsync_time += ov.exposed;
+        res.hidden_comm_time += ov.hidden;
+        add_task(SimTask::Kind::GradSync, -1, ov.exposed, "allreduce",
+                 body_gs_plain_ovl / pp);
+      }
+      if (body_gs_wus_ovl > 0) {
+        double t =
+            m.wus_rs_time(body_gs_wus_ovl / pp, gradsync_k, spans, kData) +
+            m.wus_ag_time(body_gs_wus_ovl / pp, gradsync_k, spans, kData);
+        OverlapPricing ov = overlap_price(
+            m, t, body_gs_wus_ovl / pp * m.comm_bytes_factor, hide);
+        res.gradsync_time += ov.exposed;
+        res.hidden_comm_time += ov.hidden;
+        add_task(SimTask::Kind::GradSync, -1, ov.exposed, "allreduce",
+                 body_gs_wus_ovl / pp);
+        add_task(SimTask::Kind::GradSync, -1, 0, "allgather",
+                 body_gs_wus_ovl / pp);
+      }
+    }
     res.gradsync_time += ht_gradsync;
     res.iteration_time += res.gradsync_time;
-    double upd_bw = m.hbm_bw;
-    if (measured != nullptr) {
-      auto it = measured->find("__update_bw__");
-      if (it != measured->end() && it->second > 0) upd_bw = it->second;
-    }
-    res.iteration_time += upd_bytes / upd_bw;
+    res.iteration_time += upd_time;
   }
   if (measured != nullptr) {
     auto it = measured->find("__step_overhead__");

@@ -19,6 +19,7 @@
 #pragma once
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <map>
 #include <set>
@@ -119,6 +120,12 @@ struct Choice {
   bool wus = false;                    // weight-update sharding: gradsync runs
                                        // as reduce-scatter + all-gather and the
                                        // optimizer state shards over the ring
+  bool ovl = false;                    // comms-compute overlap: the gradient
+                                       // sync issues as size-targeted bucketed
+                                       // async collectives in reverse-backward
+                                       // order; only the un-hidden tail is
+                                       // priced (overlap_price below), plus a
+                                       // per-bucket launch overhead
   double bwd_psum_bytes = 0.0;         // backward-only partial-sum all-reduce
                                        // (col-parallel dX; replicated scatter
                                        // grads) over psum_axis
@@ -131,6 +138,54 @@ struct Choice {
   double gather_bytes = 0.0;           // all-gather a parallel-op boundary
   int gather_k = 1;                    // (Combine) forces
 };
+
+// ---- latency-hiding (comms-compute overlap) pricing -----------------------
+
+// Bucket sizes the "_ovl" latency-hiding term sweeps (MB of wire payload
+// per bucket). Small buckets start hiding earlier (the un-hideable tail is
+// one bucket's comm) but each bucket pays a launch; the sweep's argmin is
+// the searched bucket size "--overlap-bucket-mb auto" follows.
+constexpr double kOvlBucketMB[] = {1.0, 2.0, 4.0, 8.0, 16.0, 32.0};
+constexpr int kOvlBucketCount = 6;
+
+struct OverlapPricing {
+  double exposed = 0;    // comm time the step still waits on
+  double hidden = 0;     // comm time priced as hidden under compute
+  int buckets = 1;
+  double bucket_mb = 0;  // argmin of the sweep
+};
+
+// Exposed time of `comm_s` seconds of gradient-sync comm issued as B
+// size-targeted buckets in reverse-backward order, with `hideable_s` of
+// compute still running when the first bucket's collective fires:
+//   exposed(B) = max(comm/B, comm - hideable) + B * launch
+// The comm/B floor is the last bucket's collective — produced by the last
+// backward op, nothing left to hide it under (the optimizer-fusion
+// prefetch window is part of hideable_s when the caller knows it).
+// `wire_bytes` are post-comm_bytes_factor payload bytes (bucket count is
+// a property of what moves on the wire).
+inline OverlapPricing overlap_price(const MachineModel& m, double comm_s,
+                                    double wire_bytes, double hideable_s) {
+  OverlapPricing best;
+  best.exposed = comm_s;
+  if (comm_s <= 0) return best;
+  bool first = true;
+  for (int i = 0; i < kOvlBucketCount; ++i) {
+    double mb = kOvlBucketMB[i];
+    int B = std::max(1, (int)std::ceil(wire_bytes / (mb * 1e6)));
+    double exp = std::max(comm_s / B, comm_s - std::max(0.0, hideable_s)) +
+                 B * m.collective_launch_overhead;
+    if (first || exp < best.exposed) {
+      best.exposed = exp;
+      best.hidden = std::max(0.0, comm_s - std::max(comm_s / B,
+                                                    comm_s - hideable_s));
+      best.buckets = B;
+      best.bucket_mb = mb;
+      first = false;
+    }
+  }
+  return best;
+}
 
 // ---- reshard cost ---------------------------------------------------------
 
@@ -217,7 +272,8 @@ inline double sharded_param_bytes(const Node& n, const Choice& c,
 inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mesh,
                                              bool enable_pp,
                                              bool enable_sp2 = true,
-                                             bool enable_wus = false) {
+                                             bool enable_wus = false,
+                                             bool enable_ovl = false) {
   using detail::div_ok;
   using detail::dp_spec;
   const int dp = mesh.dp, mp = mesh.mp;
@@ -687,6 +743,29 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
       out.push_back(std::move(c));
     }
   }
+
+  // ---- comms-compute overlap ("_ovl") variants ----------------------------
+  // Every "_wus" choice spawns an "_ovl" twin: the gradient sync issues
+  // as bucketed async collectives structured so XLA hides them under
+  // remaining backward compute, and the DP prices only the un-hidden
+  // tail plus per-bucket launch overhead (ISSUE 9). The twin can WIN at
+  // higher byte counts than a low-byte sync choice — latency hiding is
+  // a searched dimension, not an executor flag. Only WUS parents spawn
+  // twins because the runtime's bucket chaining rides on the WUS
+  // reduce-scatter shard constraints (executor._chain_constrained) —
+  // pricing hiding the executor cannot deliver would misrank strategies.
+  if (enable_ovl) {
+    const size_t base_count = out.size();
+    for (size_t bi = 0; bi < base_count; ++bi) {
+      const Choice& b = out[bi];
+      if (!b.wus) continue;
+      if (b.gradsync_bytes <= 0 || b.gradsync_k <= 1) continue;
+      Choice c = b;
+      c.name += "_ovl";
+      c.ovl = true;
+      out.push_back(std::move(c));
+    }
+  }
   return out;
 }
 
@@ -694,6 +773,15 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
 
 struct NodeCost {
   double fwd = 0, bwd = 0, comm = 0, gradsync = 0;
+  // comm seconds the "_ovl" pricing treated as hidden under compute
+  // (informational — never part of total(); the simtrace hidden lanes
+  // and the search trace's overlap column read it)
+  double gradsync_hidden = 0;
+  // bucket size (MB) the "_ovl" sweep committed to, 0 for non-ovl
+  // choices — the per-op searched value "--overlap-bucket-mb auto"
+  // follows (byte-weighted across the winning assignment)
+  double ovl_bucket_mb = 0;
+  int ovl_buckets = 0;
   double total() const { return fwd + bwd + comm + gradsync; }
 };
 
@@ -811,19 +899,40 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   }
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1) {
     int spans = slices_spanned(mesh, m);
+    double sync;
     if (c.wus)
       // WUS: reduce-scatter the gradients, update shard-locally, then
       // all-gather the updated (bf16) compute params — roughly the
       // all-reduce's wire bytes, but the optimizer update and its state
       // shrink by gradsync_k (node_param_memory / the simulator's
       // update-traffic term), which is where WUS wins.
-      nc.gradsync = m.wus_rs_time(c.gradsync_bytes, c.gradsync_k, spans,
-                                  kData) +
-                    m.wus_ag_time(c.gradsync_bytes, c.gradsync_k, spans,
-                                  kData);
+      sync = m.wus_rs_time(c.gradsync_bytes, c.gradsync_k, spans, kData) +
+             m.wus_ag_time(c.gradsync_bytes, c.gradsync_k, spans, kData);
     else
-      nc.gradsync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k,
-                                          spans, kData);
+      sync = m.hier_allreduce_time(c.gradsync_bytes, c.gradsync_k, spans,
+                                   kData);
+    if (c.ovl) {
+      // latency hiding: the bucketed async sync hides under the overlap
+      // window the DP already prices for this op — its backward compute
+      // (early buckets' collectives ride under the rest of backward)
+      // plus, when the update-triad term is being priced, the optimizer
+      // fusion tail the WUS param all-gather prefetches under.
+      double hide = nc.bwd;
+      if (opt_state_factor >= 0 && n.param_bytes() > 0) {
+        double upd = detail::sharded_param_bytes(n, c, mesh) *
+                     (3.0 + 2.0 * opt_state_factor) / m.hbm_bw;
+        if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
+        hide += upd;
+      }
+      OverlapPricing ov = overlap_price(
+          m, sync, c.gradsync_bytes * m.comm_bytes_factor, hide);
+      nc.gradsync = ov.exposed;
+      nc.gradsync_hidden = ov.hidden;
+      nc.ovl_bucket_mb = ov.bucket_mb;
+      nc.ovl_buckets = ov.buckets;
+    } else {
+      nc.gradsync = sync;
+    }
   }
   if (training && opt_state_factor >= 0 && n.param_bytes() > 0) {
     double upd = detail::sharded_param_bytes(n, c, mesh) *

@@ -157,7 +157,15 @@ def summarize_run(stem, arts):
                 tot.get("exposed_comms_s", 0.0) / tot["wall_s"], 4)
         dt["collectives"] = {
             k: dict(per_step_s=_round(e.get("per_step_s")),
-                    count=e.get("count"))
+                    count=e.get("count"),
+                    # hidden-vs-exposed split per kind (ISSUE 9): where
+                    # the comms-compute overlap actually lands
+                    **({"overlapped_per_step_s":
+                        _round(e.get("overlapped_per_step_s")),
+                        "exposed_per_step_s":
+                        _round(e.get("exposed_per_step_s"))}
+                       if e.get("overlapped_per_step_s") is not None
+                       else {}))
             for k, e in (devtrace.get("collectives") or {}).items()}
         row["devtrace"] = dt
     if drift:
@@ -185,6 +193,10 @@ def summarize_run(stem, arts):
                    bwd_s=_round(pred.get("bwd_s"), 9),
                    comm_s=_round(pred.get("comm_s"), 9),
                    gradsync_s=_round(pred.get("gradsync_s"), 9))
+        if pred.get("hidden_comm_s") is not None:
+            # the latency-hiding term: predicted comm hidden under
+            # compute, to read against devtrace's overlapped_comms_s
+            sim["hidden_comm_s"] = _round(pred.get("hidden_comm_s"), 9)
         meas_p50 = row.get("step_time_p50_s")
         if pred.get("step_s") and meas_p50:
             sim["predicted_vs_measured"] = _round(
@@ -253,6 +265,22 @@ def to_markdown(report):
                 exp=_fmt(dt.get("exposed_comms_s", 0.0) / n * 1e3
                          if n else None),
                 ratio=_fmt(r.get("drift_ratio"))))
+    # per-kind hidden-vs-exposed device time (ISSUE 9): which collective
+    # kinds the overlap structuring actually hides, per run
+    kinds = [(r["run"], k, e) for r in report["runs"]
+             for k, e in ((r.get("devtrace") or {}).get("collectives")
+                          or {}).items()
+             if e.get("overlapped_per_step_s") is not None]
+    if kinds:
+        lines += ["", "## Device collectives: hidden vs exposed", "",
+                  "| run | kind | ms/step | hidden ms/step | "
+                  "exposed ms/step |",
+                  "|---|---|---|---|---|"]
+        for run, kind, e in kinds:
+            lines.append(f"| {run} | {kind} | "
+                         f"{_fmt(e.get('per_step_s'), 1e3)} | "
+                         f"{_fmt(e.get('overlapped_per_step_s'), 1e3)} | "
+                         f"{_fmt(e.get('exposed_per_step_s'), 1e3)} |")
     drifts = [(r["run"], k, e) for r in report["runs"]
               for k, e in (r.get("collective_drift") or {}).items()]
     if drifts:

@@ -38,5 +38,31 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/explain.py --model transf
 if [ "$explain_rc" -ne 0 ]; then echo "EXPLAIN: failed (exit $explain_rc, see /tmp/_t1_explain.err) — non-fatal"; else echo "EXPLAIN: written (SEARCH_TRACE.json, EXPLAIN.md)"; fi
 timeout -k 10 120 python scripts/obs_report.py "$FFS_T1_TRACE_DIR" --out OBS_REPORT.json > /dev/null 2> /tmp/_t1_obs.err; obs_rc=$?
 if [ "$obs_rc" -ne 0 ]; then echo "OBS: report failed (exit $obs_rc, see /tmp/_t1_obs.err) — non-fatal"; else echo "OBS: report written (OBS_REPORT.json)"; fi
+# overlap-fields assert (ISSUE 9, non-fatal like the explain stage): the
+# t1 trace dir's report must carry the comms-compute-overlap coordinates
+# — devtrace exposed/overlapped totals (+ per-kind hidden/exposed split
+# when collectives were captured) and the sim block's hidden_comm_s.
+timeout -k 10 60 python - > /tmp/_t1_ovl.out 2>&1 <<'EOF'
+import json, sys
+r = json.load(open("OBS_REPORT.json"))
+runs = r.get("runs") or []
+dev = [x for x in runs if x.get("devtrace")]
+sims = [x for x in runs if x.get("sim")]
+missing = []
+if not any("exposed_comms_s" in (x["devtrace"] or {}) for x in dev):
+    missing.append("devtrace.exposed_comms_s")
+if not any("overlapped_comms_s" in (x["devtrace"] or {}) for x in dev):
+    missing.append("devtrace.overlapped_comms_s")
+if not any("hidden_comm_s" in (x["sim"] or {}) for x in sims):
+    missing.append("sim.hidden_comm_s")
+for x in dev:
+    for k, e in ((x["devtrace"] or {}).get("collectives") or {}).items():
+        if "exposed_per_step_s" not in e:
+            missing.append(f"devtrace.collectives[{k}].exposed_per_step_s")
+print("missing: " + ", ".join(missing) if missing else "ok")
+sys.exit(1 if missing else 0)
+EOF
+ovl_rc=$?
+if [ "$ovl_rc" -ne 0 ]; then echo "OBS overlap fields: $(cat /tmp/_t1_ovl.out) — non-fatal"; else echo "OBS overlap fields: ok"; fi
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
 exit $rc

@@ -179,9 +179,21 @@ class TestFixtureAttribution:
         assert rep["totals"]["exposed_comms_s"] == pytest.approx(300e-6)
         # per-kind measured seconds: the drift join's measured half
         coll = rep["collectives"]
-        assert coll["all-reduce"] == pytest.approx(
-            dict(time_s=300e-6, count=1, per_step_s=150e-6))
+        ar = coll["all-reduce"]
+        assert ar["time_s"] == pytest.approx(300e-6)
+        assert ar["count"] == 1
+        assert ar["per_step_s"] == pytest.approx(150e-6)
+        # per-kind hidden/exposed split (ISSUE 9): each kind's measured
+        # time partitions into overlapped-under-compute + exposed
+        for e in coll.values():
+            assert e["overlapped_s"] + e["exposed_s"] == pytest.approx(
+                e["time_s"])
+            assert (e["overlapped_per_step_s"] + e["exposed_per_step_s"]
+                    == pytest.approx(e["per_step_s"]))
+        # the all-gather sits entirely under compute in the fixture
         assert coll["all-gather"]["per_step_s"] == pytest.approx(150e-6)
+        assert coll["all-gather"]["exposed_per_step_s"] == pytest.approx(
+            0.0, abs=1e-12)
         assert coll["reduce-scatter"]["per_step_s"] == pytest.approx(50e-6)
 
 

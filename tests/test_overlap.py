@@ -1,0 +1,430 @@
+"""Comms-compute overlap as a searched dimension (ISSUE 9): bucketed
+async grad reduce-scatter in the executor (reverse-backward bucket
+partition chained through optimization_barrier — bit-for-bit identical
+to the synchronous sync), "_ovl" latency-hiding choice twins in the
+native search (exposed = max(comm/B, comm - hideable) + B x launch,
+bucket size swept and recorded), per-op WUS granularity, the
+exposed-comms bench ratchet, and the fflint FFL207 rejected-overlap
+INFO rule.
+
+Runs on the conftest 8-device virtual CPU mesh.
+"""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import LossType
+from flexflow_tpu.machine import make_mesh
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.optimizers import AdamOptimizer
+
+BATCH = 16
+
+
+def build_mlp(wus_mode="on", overlap="auto", seed=42):
+    """The test_wus MLP shape (param-heavy, pure data mesh) with the
+    overlap knobs exposed."""
+    cfg = FFConfig(batch_size=BATCH, seed=seed)
+    cfg.weight_update_sharding = wus_mode
+    cfg.overlap_bucket_mb = overlap
+    ff = FFModel(cfg)
+    x = ff.create_tensor((BATCH, 64), name="x")
+    t = ff.dense(x, 512, name="d0")
+    t = ff.relu(t)
+    t = ff.dense(t, 64, name="d1")
+    ff.compile(AdamOptimizer(alpha=1e-2),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               mesh=make_mesh(8, {"data": 8}))
+    return ff
+
+
+class TestFlagAndAuto:
+    def test_flag_parsing(self):
+        cfg = FFConfig()
+        assert cfg.parse_args(["--overlap-bucket-mb", "8"]) == []
+        assert cfg.overlap_bucket_mb == "8"
+        assert FFConfig().parse_args(["--overlap-bucket-mb", "auto"]) == []
+        with pytest.raises(ValueError):
+            FFConfig().parse_args(["--overlap-bucket-mb", "many"])
+
+    def test_auto_engages_with_heuristic_wus(self):
+        ff = build_mlp("on", "auto")
+        assert ff.executor.grad_overlap
+        # MB (1e6), the native bucket sweep's wire-byte unit
+        assert ff.executor.overlap_bucket_bytes == 4_000_000
+
+    def test_explicit_bucket_and_off(self):
+        assert build_mlp("on", "1").executor.overlap_bucket_bytes == 1_000_000
+        assert not build_mlp("on", "off").executor.grad_overlap
+        assert not build_mlp("on", "0").executor.grad_overlap
+
+    def test_overlap_requires_wus(self):
+        # bucketing partitions the WUS grad tree; without WUS there are
+        # no explicit sync constraints to bucket
+        assert not build_mlp("off", "4").executor.grad_overlap
+
+
+class TestBucketedParity:
+    def test_bucketed_async_parity_bitwise(self):
+        """Acceptance: bucketed-async vs synchronous grad sync are
+        bit-for-bit identical for 3 seeded f32 steps on the 8-way data
+        mesh (the barrier chain is the identity on values)."""
+        rs = np.random.RandomState(0)
+        x = rs.randn(3 * BATCH, 64).astype(np.float32)
+        y = rs.randn(3 * BATCH, 64).astype(np.float32)
+        losses = {}
+        for mode, ovl in (("sync", "off"), ("bucketed", "1")):
+            ff = build_mlp("on", ovl)
+            # 1-MB buckets split this model's ~1.2 MB of f32 grads, so
+            # the chained path (multiple buckets) is really exercised
+            assert ff.executor.grad_overlap == (mode == "bucketed")
+            ls = []
+            for s in range(3):
+                ff.set_batch(x[s * BATCH:(s + 1) * BATCH],
+                             y[s * BATCH:(s + 1) * BATCH])
+                ff.forward(); ff.backward(); ff.update()
+                ls.append(np.float32(ff._last_loss))
+            losses[mode] = ls
+        assert all(np.isfinite(v) for v in losses["bucketed"])
+        for a, b in zip(losses["sync"], losses["bucketed"]):
+            assert a.tobytes() == b.tobytes(), losses
+
+    def test_fit_eval_roundtrip_with_overlap(self):
+        ff = build_mlp("on", "1")
+        rs = np.random.RandomState(1)
+        x = rs.randn(BATCH, 64).astype(np.float32)
+        y = rs.randn(BATCH, 64).astype(np.float32)
+        rep = ff.evaluate(x, y)
+        assert np.isfinite(rep["loss"])
+
+
+class TestPerOpWusGranularity:
+    """ROADMAP carried follow-on: the executor honors each op's searched
+    '_wus' choice instead of applying WUS globally."""
+
+    def test_wus_ops_gates_specs(self):
+        ff = build_mlp("on")
+        ex = ff.executor
+        assert ex.wus_spec("d0", "kernel", (64, 512)) is not None
+        ex.wus_ops = {"d0"}  # as a mixed searched strategy would set
+        assert ex.wus_spec("d0", "kernel", (64, 512)) is not None
+        assert ex.wus_spec("d1", "kernel", (512, 64)) is None
+        specs = ex.wus_param_specs()
+        assert "d0" in specs and "d1" not in specs
+
+    def test_replay_honors_per_op_choices(self):
+        """simulate_strategy replays what the executor EXECUTES: ops in
+        wus_ops carry the _wus(_ovl) suffixes, the rest stay plain."""
+        from flexflow_tpu.search import validate as V
+
+        ff = build_mlp("on", "1")
+        ff.executor.wus_ops = {"d0"}
+        captured = {}
+        orig = V.native_simulate if hasattr(V, "native_simulate") else None
+
+        import flexflow_tpu.search.native as native
+        real = native.native_simulate
+
+        def spy(req):
+            captured.update(req["assignment"])
+            return real(req)
+
+        V_native = native.native_simulate
+        native.native_simulate = spy
+        try:
+            V.simulate_strategy(ff)
+        finally:
+            native.native_simulate = V_native
+        by_name = {n.op.name: str(n.op.guid) for n in ff.executor.nodes}
+        assert captured[by_name["d0"]].endswith("_wus_ovl")
+        assert "_wus" not in captured[by_name["d1"]]
+
+    def test_model_builds_wus_ops_from_searched_choices(self):
+        """FFModel.compile keys the per-op set off the searched '_wus'
+        choices under 'auto' (forced 'on' stays global)."""
+        assert build_mlp("on").executor.wus_ops is None
+
+
+class TestNativeOvlPricing:
+    """Acceptance: '_ovl' twins price distinctly from their sync
+    parents, with identical census bytes, and the bucket sweep is
+    recorded in the search trace."""
+
+    @staticmethod
+    def _chain_nodes(b=256, d=1024):
+        roles = [["sample", "channel"]]
+        lin = dict(input_shapes=[[b, d]], output_shapes=[[b, d]],
+                   roles=roles, params={"kernel": [d, d], "bias": [d]},
+                   flops=b * d * d * 2.0, dtype_size=4, attrs={})
+        return [
+            dict(guid=1, type="INPUT", name="x", inputs=[],
+                 input_shapes=[], output_shapes=[[b, d]], roles=roles,
+                 params={}, flops=0.0, dtype_size=4, attrs={}),
+            dict(lin, guid=2, name="d1", inputs=[[1, 0]]),
+            dict(lin, guid=3, name="d2", inputs=[[2, 0]]),
+        ]
+
+    _MACHINE = {"num_devices": 8, "flops": 1e12, "hbm_bw": 1e11,
+                "hbm_cap": 16e9, "ici_bw": 1e10, "ici_latency": 1e-6,
+                "dcn_bw": 1e9, "dcn_latency": 1e-5, "num_slices": 1}
+
+    def _sim(self, choice):
+        from flexflow_tpu.search.native import available, native_simulate
+        if not available():
+            pytest.skip("native search unavailable")
+        return native_simulate({
+            "nodes": self._chain_nodes(), "machine": self._MACHINE,
+            "measured": {},
+            "config": {"training": True, "overlap": False,
+                       "opt_state_factor": 2.0},
+            "mesh": {"data": 8, "model": 1, "seq": 1, "expert": 1},
+            "assignment": {"1": "rep", "2": choice, "3": choice}})
+
+    @pytest.mark.parametrize("parent", ["dp_wus"])
+    def test_ovl_twin_prices_distinctly(self, parent):
+        sync = self._sim(parent)
+        ovl = self._sim(parent + "_ovl")
+        # the twin hides real comm under compute and the step shortens
+        assert sync["hidden_comm_time"] == 0
+        assert ovl["hidden_comm_time"] > 0
+        assert ovl["iteration_time"] < sync["iteration_time"]
+        assert any(t.get("hidden_s") for t in ovl["tasks"])
+        # census bytes are byte-for-byte identical: bucketing changes
+        # WHEN collectives fire, never what moves on the wire
+
+        def census(r):
+            out = {}
+            for t in r["tasks"]:
+                if t.get("collective"):
+                    out[t["collective"]] = out.get(t["collective"], 0.0) \
+                        + t["bytes"]
+            return out
+
+        assert census(sync) == census(ovl)
+
+    def test_plain_ovl_not_enumerated_replays_as_sync(self):
+        """Only '_wus' parents spawn '_ovl' twins — the runtime's bucket
+        chaining rides on the WUS shard constraints, so pricing hiding
+        for plain sync would misrank strategies the executor then runs
+        synchronously. A (stale/heuristic) 'dp_ovl' request falls back
+        along the suffix lattice to plain 'dp' — never to '_wus'
+        pricing the op doesn't execute."""
+        sync = self._sim("dp")
+        ovl = self._sim("dp_ovl")
+        assert ovl["hidden_comm_time"] == 0
+        assert ovl["iteration_time"] == pytest.approx(
+            sync["iteration_time"])
+        assert ovl["memory"] == pytest.approx(sync["memory"])
+
+    def test_bucket_sweep_recorded_in_search_trace(self):
+        from flexflow_tpu.search.native import available, native_optimize
+        if not available():
+            pytest.skip("native search unavailable")
+        resp = native_optimize(dict(
+            nodes=self._chain_nodes(), machine=self._MACHINE, measured={},
+            config=dict(budget=1, training=True, enable_substitution=False,
+                        only_data_parallel=True, batch=256,
+                        emit_search_trace=True)))
+        ops = {o["name"]: o for o in resp["search_trace"]["ops"]}
+        ovl_cands = [c for c in ops["d1"]["candidates"]
+                     if "_ovl" in c["choice"]]
+        assert ovl_cands, [c["choice"] for c in ops["d1"]["candidates"]]
+        for c in ovl_cands:
+            ov = c["overlap"]
+            assert ov["bucket_mb"] > 0
+            assert ov["buckets"] >= 1
+            sweep = ov["sweep"]
+            assert len(sweep) >= 4
+            for row in sweep:
+                assert row["bucket_mb"] > 0 and row["exposed_s"] > 0
+            # the committed bucket is the sweep's argmin
+            best = min(sweep, key=lambda r: r["exposed_s"])
+            assert best["bucket_mb"] == ov["bucket_mb"]
+            assert "hidden_s" in c["terms"]
+
+    def test_searched_bert_family_picks_ovl_on_v4_32(self):
+        """Acceptance: the searched BERT-family strategy on the
+        simulated v4-32 takes an '_ovl' choice, and the strategy records
+        the searched bucket size 'auto' follows."""
+        from flexflow_tpu.machine import MachineSpec
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.optimizers import SGDOptimizer
+        from flexflow_tpu.search.native import available, native_optimize
+        from flexflow_tpu.search.unity import (machine_to_json,
+                                               serialize_graph)
+        if not available():
+            pytest.skip("native search unavailable")
+        n_chips = 32
+        mcfg = TransformerConfig(num_layers=2, hidden_size=1024,
+                                 num_heads=16, seq_length=64,
+                                 batch_size=n_chips)
+        ff = create_transformer(
+            mcfg, FFConfig(batch_size=mcfg.batch_size,
+                           only_data_parallel=True, workers_per_node=1))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        resp = native_optimize(dict(
+            nodes=serialize_graph(ff.executor.nodes),
+            machine=machine_to_json(
+                MachineSpec(chip="tpu-v4", chips_per_slice=n_chips),
+                n_chips, comm_bytes_factor=0.5),
+            measured={},
+            config=dict(budget=4, alpha=0.05, training=True, overlap=True,
+                        batch=mcfg.batch_size, opt_state_factor=2.0,
+                        seed=42, rules=[], enable_parameter_parallel=True,
+                        enable_substitution=False,
+                        enable_pipeline_parallel=False)))
+        choices = {v["choice"] for v in resp["ops"].values()}
+        assert any("_ovl" in c for c in choices), choices
+        assert resp["overlap"]["bucket_mb"] > 0
+        assert resp["overlap"]["ops"] >= 1
+
+    def test_ovl_disabled_removes_dimension(self):
+        from flexflow_tpu.search.native import available, native_optimize
+        if not available():
+            pytest.skip("native search unavailable")
+        resp = native_optimize(dict(
+            nodes=self._chain_nodes(), machine=self._MACHINE, measured={},
+            config=dict(budget=1, training=True, enable_substitution=False,
+                        only_data_parallel=True, batch=256,
+                        comm_overlap="off", emit_search_trace=True)))
+        names = [c["choice"] for o in resp["search_trace"]["ops"]
+                 for c in o["candidates"]]
+        assert not any("_ovl" in n for n in names)
+
+
+class TestSimtraceHidden:
+    def test_sim_lane_events_carry_hidden(self):
+        from flexflow_tpu.obs.simtrace import sim_lane_events
+        tasks = [dict(kind="gradsync", node=0, start=0.0, finish=1e-3,
+                      collective="allreduce", bytes=4096, hidden_s=4e-4)]
+        (ev,) = sim_lane_events(tasks, {0: "d"})
+        assert ev["args"]["hidden_s"] == pytest.approx(4e-4)
+
+    def test_simtrace_predicted_hidden_comm(self):
+        from flexflow_tpu.obs.simtrace import simtrace_report
+        from flexflow_tpu.search.validate import simulate_strategy
+        ff = build_mlp("on", "1")
+        resp = simulate_strategy(ff)
+        assert "hidden_comm_time" in resp
+        rep = simtrace_report(ff, resp)
+        assert rep["predicted"]["hidden_comm_s"] is not None
+        # per-op rows aggregate the hidden term
+        assert all("hidden_s" in r["priced"] for r in rep["per_op"])
+
+
+class TestFflint:
+    @pytest.mark.analysis
+    def test_bucketed_census_is_clean(self):
+        """The bucketed RS shape (N bucket collectives summing to the
+        unbucketed payload) diffs FFL2xx-clean: both inference and the
+        emitted census aggregate bytes per kind."""
+        from flexflow_tpu.analysis import LintContext, run_passes
+        from flexflow_tpu.analysis.passes.collectives import (
+            CollectiveInferencePass)
+        ff = build_mlp("on", "1")
+        ctx = LintContext(nodes=ff.executor.nodes, mesh=ff.mesh,
+                          strategy=ff.strategy, ff=ff)
+        rep = run_passes(ctx, [CollectiveInferencePass()])
+        assert rep.passes["collective-inference"] == "ok"
+        bad = [d for d in rep.errors if d.rule.startswith("FFL2")]
+        assert not bad, "\n".join(d.format() for d in bad)
+
+    def test_ffl207_flags_rejected_overlap(self):
+        from flexflow_tpu.analysis.passes.collectives import (
+            CollectiveInferencePass)
+
+        def op_row(chosen, cands):
+            return dict(name="dense", chosen=chosen, candidates=[
+                dict(choice=c, chosen=(c == chosen),
+                     terms=dict(total_s=1.0, collective_s=s))
+                for c, s in cands])
+
+        def ctx_for(ops):
+            ff = types.SimpleNamespace(
+                search_info=dict(search_trace=dict(ops=ops)))
+            return types.SimpleNamespace(ff=ff)
+
+        p = CollectiveInferencePass()
+        # rejected _ovl twin + high exposed share -> INFO FFL207
+        diags = p._overlap_rejections(ctx_for([op_row(
+            "dp", [("dp", 0.5), ("dp_ovl", 0.2)])]))
+        assert [d.rule for d in diags] == ["FFL207"]
+        assert diags[0].severity.name == "INFO"
+        # chosen _ovl: nothing was rejected
+        assert not p._overlap_rejections(ctx_for([op_row(
+            "dp_ovl", [("dp", 0.5), ("dp_ovl", 0.2)])]))
+        # low exposed share: rejection is justified
+        assert not p._overlap_rejections(ctx_for([op_row(
+            "dp", [("dp", 0.05), ("dp_ovl", 0.2)])]))
+        # no twin enumerated: not FFL207's business
+        assert not p._overlap_rejections(ctx_for([op_row(
+            "dp", [("dp", 0.5)])]))
+
+
+class TestExposedRatchet:
+    def test_ratchet_records_flags_and_skips(self, monkeypatch):
+        import bench
+        monkeypatch.delenv("FFS_SKIP_EXPOSED", raising=False)
+        hist = {}
+        # first measurement seeds the low-water mark
+        reg, base = bench.exposed_ratchet(hist, "w:cpu", 0.30)
+        assert (reg, base) == (False, None)
+        assert hist["w:cpu"]["exposed_comms_frac"] == 0.30
+        # an overlap win ratchets DOWN — clamped to halving per round,
+        # so one outlier-low capture window cannot set an unreachable
+        # floor (the fraction is a noisy measured metric)
+        reg, _ = bench.exposed_ratchet(hist, "w:cpu", 0.10)
+        assert not reg
+        assert hist["w:cpu"]["exposed_comms_frac"] == 0.15
+        # re-exposing comms beyond tol+abs flags a regression and keeps
+        # the recorded best
+        reg, base = bench.exposed_ratchet(hist, "w:cpu", 0.20)
+        assert reg and base == 0.15
+        assert hist["w:cpu"]["exposed_comms_frac"] == 0.15
+        # sustained improvement converges geometrically
+        reg, _ = bench.exposed_ratchet(hist, "w:cpu", 0.05)
+        assert not reg
+        assert hist["w:cpu"]["exposed_comms_frac"] == 0.075
+        # noise-level drift above a ~zero baseline never flags
+        bench.exposed_ratchet(hist, "z:cpu", 0.0)
+        reg, _ = bench.exposed_ratchet(hist, "z:cpu", 0.004)
+        assert not reg
+        # FFS_SKIP_EXPOSED mirrors the census ratchet's opt-out
+        monkeypatch.setenv("FFS_SKIP_EXPOSED", "1")
+        reg, _ = bench.exposed_ratchet(hist, "w:cpu", 0.9)
+        assert not reg
+
+
+class TestSearchedOverlapWiring:
+    def test_searched_ovl_engages_executor(self):
+        """A searched strategy that picks '_ovl' twins turns the
+        executor's bucketed structuring on under 'auto', with the
+        searched bucket size."""
+        cfg = FFConfig(batch_size=64)
+        cfg.search_budget = 2
+        cfg.enable_parameter_parallel = True
+        cfg.enable_pipeline_parallel = False
+        ff = FFModel(cfg)
+        x = ff.create_tensor((64, 512), name="x")
+        t = ff.dense(x, 2048, name="h0")
+        t = ff.relu(t)
+        t = ff.dense(t, 512, name="h1")
+        ff.compile(AdamOptimizer(alpha=1e-3),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        choices = [getattr(ff.strategy.get(n.op.guid), "choice", None) or ""
+                   for n in ff.executor.nodes]
+        if not any("_ovl" in c for c in choices):
+            pytest.skip("search did not pick _ovl on this machine model")
+        assert ff.executor.grad_overlap
+        assert ff.overlap_enabled
+        info = ff.search_info.get("overlap") or {}
+        if info.get("bucket_mb"):
+            # MB (1e6), the native bucket sweep's wire-byte unit
+            assert ff.executor.overlap_bucket_bytes == \
+                int(float(info["bucket_mb"]) * 1e6)

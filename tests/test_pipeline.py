@@ -806,7 +806,10 @@ class TestPipelineNativePricing:
         mesh = r["mesh"]
         assert mesh.get("pipe", 1) > 1 and mesh.get("data", 1) > 1, mesh
         choices = {v["choice"] for v in r["ops"].values()}
-        assert all(c.endswith("_wus") for c in choices), choices
+        # the memory-capped search must keep picking the WUS dimension;
+        # since ISSUE 9 the latency-hiding "_ovl" twin of a "_wus" choice
+        # (dp_wus_ovl) also satisfies it — suffix order is base[_wus][_ovl]
+        assert all("_wus" in c for c in choices), choices
         pj = r.get("pipeline") or {}
         assert pj.get("microbatches", 0) >= 2 * mesh["pipe"]
         assert pj.get("schedule") in ("gpipe", "circular"), pj

@@ -469,8 +469,10 @@ class TestMultiSlice:
                                 "config": cfg, "measured": {},
                                 "nodes": nodes, "final": [3, 0]})
         # fast DCN: sharded training with cross-slice gradient sync (the
-        # search may additionally pick the weight-update-sharding twin)
-        assert fast["ops"]["1"]["choice"] in ("dp_col", "dp_col_wus"), \
+        # search may additionally pick the weight-update-sharding and/or
+        # latency-hiding twins — suffix order is base[_wus][_ovl])
+        assert fast["ops"]["1"]["choice"] in (
+            "dp_col", "dp_col_wus", "dp_col_ovl", "dp_col_wus_ovl"), \
             fast["ops"]
         # slow DCN: the search abandons parameter sync entirely —
         # replicated weights, no gradient ring over the starved DCN
