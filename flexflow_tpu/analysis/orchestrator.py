@@ -112,6 +112,7 @@ def all_passes():
     """The shipped pass pipeline, in execution order (cheap graph-shape
     checks first so their findings frame the expensive ones)."""
     from flexflow_tpu.analysis.passes.calibration import CalibrationPass
+    from flexflow_tpu.analysis.passes.checkpoint import CheckpointIntegrityPass
     from flexflow_tpu.analysis.passes.collectives import CollectiveInferencePass
     from flexflow_tpu.analysis.passes.dtype import DtypePolicyPass
     from flexflow_tpu.analysis.passes.hygiene import GraphHygienePass
@@ -126,6 +127,7 @@ def all_passes():
         CollectiveInferencePass(),
         MultihostOrderPass(),
         CalibrationPass(),
+        CheckpointIntegrityPass(),
     ]
 
 

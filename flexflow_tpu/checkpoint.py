@@ -1,61 +1,46 @@
-"""Training-state checkpoint / resume.
+"""Training-state checkpoint / resume — legacy v1 single-file format.
 
 The reference has **no** model-state checkpointing (SURVEY §5.4 — only
-weight get/set and strategy files); this is deliberate new scope for the
-TPU framework: full (params, optimizer state, op state, iteration) capture
-to a single .npz plus a JSON manifest, restoring onto the live shardings.
+weight get/set and strategy files); this v1 format was the first new
+scope: full (params, optimizer state, op state, iteration) capture to a
+single .npz plus a JSON manifest, restoring onto the live shardings.
+It all-gathers every sharded leaf onto every host and funnels the write
+through rank 0 — fine for one host, a step-loop stall and a
+shared-filesystem trap at scale. New runs should use the v2 per-shard
+package (flexflow_tpu/ckpt): each host writes only its addressable
+shards, asynchronously, with a manifest-last commit record.
+``load_checkpoint`` auto-detects both formats, so v1 checkpoints remain
+a supported migration path.
 
-Format: flattened pytree with '/'-joined key paths. Works for any nesting
-of dict/list/tuple with array leaves, so SGD momentum and Adam (m, v, t)
-states round-trip unchanged.
+v1 hardening (ISSUE 10 satellites):
+
+* crash-atomic: the .npz and the manifest are written tmp+``os.replace``
+  with the manifest LAST — a save preempted mid-write can no longer
+  shadow the previous good checkpoint with a corrupt half-file;
+* bf16-exact: ml_dtypes bfloat16 leaves are stored as uint16 bit views
+  with the true dtype recorded in the manifest (older checkpoints that
+  took the f32 widening detour still load);
+* fail-fast: on multi-host, every rank checks visibility of the files
+  and the ranks AGREE before anyone touches a collective — a
+  non-shared filesystem yields one actionable error on every rank
+  instead of FileNotFoundError-then-deadlock (ADVICE r5).
+
+Format: flattened pytree with '/'-joined key paths. Works for any
+nesting of dict/list/tuple with array leaves, so SGD momentum and Adam
+(m, v, t) states round-trip unchanged.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Tuple
+from typing import Dict
 
 import numpy as np
 import jax
 
-
-def _flatten(tree, prefix="") -> List[Tuple[str, Any]]:
-    if isinstance(tree, dict):
-        out = []
-        for k in sorted(tree):
-            out += _flatten(tree[k], f"{prefix}{k}/")
-        return out
-    if isinstance(tree, (list, tuple)):
-        out = []
-        for i, v in enumerate(tree):
-            out += _flatten(v, f"{prefix}{i}/")
-        return out
-    return [(prefix[:-1], tree)]
-
-
-def _structure(tree):
-    """JSON-able skeleton used to rebuild nesting on load."""
-    if isinstance(tree, dict):
-        return {"__kind__": "dict",
-                "items": {k: _structure(v) for k, v in tree.items()}}
-    if isinstance(tree, tuple):
-        return {"__kind__": "tuple", "items": [_structure(v) for v in tree]}
-    if isinstance(tree, list):
-        return {"__kind__": "list", "items": [_structure(v) for v in tree]}
-    return {"__kind__": "leaf"}
-
-
-def _rebuild(skel, flat: Dict[str, Any], prefix=""):
-    kind = skel["__kind__"]
-    if kind == "dict":
-        return {k: _rebuild(v, flat, f"{prefix}{k}/")
-                for k, v in skel["items"].items()}
-    if kind in ("list", "tuple"):
-        seq = [_rebuild(v, flat, f"{prefix}{i}/")
-               for i, v in enumerate(skel["items"])]
-        return tuple(seq) if kind == "tuple" else seq
-    return flat[prefix[:-1]]
+from flexflow_tpu.ckpt.tree import (flatten_tree, place_tree, rebuild_tree,
+                                    tree_structure)
 
 
 def save_checkpoint(path: str, ffmodel) -> None:
@@ -75,37 +60,48 @@ def save_checkpoint(path: str, ffmodel) -> None:
         "op_state": {k: v for k, v in ffmodel.state.items()
                      if k != COMPUTE_PARAMS_KEY},
     }
-    flat = _flatten(state)
+    flat = flatten_tree(state)
     multihost = jax.process_count() > 1
     arrays = {}
     scalars = {}
+    dtypes: Dict[str, str] = {}
+    from flexflow_tpu.ckpt.sharded import _bit_view
     for k, v in flat:
         if hasattr(v, "shape"):
             # cross-host shards are not host-readable directly — gather
             # (no-op single-process)
             arr = (distributed.all_gather_host(v) if multihost
                    else np.asarray(v))
-            if arr.dtype.kind not in "fiub":
-                # np.savez writes non-native dtypes (ml_dtypes bfloat16)
-                # as raw void bytes that cannot load back — store as f32;
-                # load re-casts to the live leaf's dtype
-                arr = arr.astype(np.float32)
-            arrays[k] = arr
+            # np.savez writes non-native dtypes (ml_dtypes bfloat16) as
+            # raw void bytes that cannot load back — store the exact
+            # bits as an unsigned-int view (shared codec with the v2
+            # format), true dtype recorded in the manifest
+            saved, true, saved_dt = _bit_view(arr)
+            if saved_dt != true:
+                dtypes[k] = true
+            arrays[k] = saved
         else:
             scalars[k] = v
     if not multihost or distributed.process_index() == 0:
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
-                    exist_ok=True)
-        np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+        npz_path = path if path.endswith(".npz") else path + ".npz"
+        # crash-atomic: .npz first, manifest LAST — the manifest is the
+        # commit record, so a preemption mid-save leaves the previous
+        # (path, manifest) pair intact or fully replaces both
+        from flexflow_tpu.ckpt.manifest import atomic_replace, \
+            atomic_write_json
+        with atomic_replace(npz_path) as f:
+            np.savez(f, **arrays)
         manifest = {
             "version": 1,
             "iteration": ffmodel._iter,
-            "structure": _structure(state),
+            "rng": [int(x) for x in np.asarray(ffmodel._rng).ravel()],
+            "structure": tree_structure(state),
             "scalars": scalars,
             "array_keys": sorted(arrays),
+            # true dtypes of bit-view-stored leaves (bf16-exact satellite)
+            "dtypes": dtypes,
         }
-        with open(_manifest_path(path), "w") as f:
-            json.dump(manifest, f)
+        atomic_write_json(_manifest_path(path), manifest)
     if multihost:
         # no rank may observe save_checkpoint as complete before the
         # files are durable (a preemption handler or an immediate load
@@ -119,64 +115,96 @@ def _manifest_path(path: str) -> str:
     return base + ".manifest.json"
 
 
-def load_checkpoint(path: str, ffmodel) -> int:
-    """Restore state saved by save_checkpoint onto the live shardings.
+def _check_visible(path: str) -> None:
+    """ADVICE r5 fix: agreement on file visibility BEFORE any rank
+    enters the collectives a cross-host load performs. A checkpoint
+    rank 0 wrote to a non-shared filesystem used to be a
+    FileNotFoundError on the other ranks followed by rank 0 hanging in
+    its gather — now every rank raises the same actionable error."""
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    visible = (os.path.exists(npz_path)
+               and os.path.exists(_manifest_path(path)))
+    if jax.process_count() <= 1:
+        if not visible:
+            raise FileNotFoundError(
+                f"no checkpoint at '{path}' (expected {npz_path} + "
+                f"{_manifest_path(path)})")
+        return
+    from flexflow_tpu import distributed
+    seen, _ = distributed.ranks_agree(1 if visible else 0)
+    if not all(seen):
+        bad = [r for r, v in enumerate(seen) if not v]
+        raise FileNotFoundError(
+            f"checkpoint '{path}' is not visible on rank(s) {bad} "
+            f"(per-rank visibility: {seen}). Multi-host load requires "
+            f"the checkpoint on a filesystem shared by every host "
+            f"(GCS/NFS) — or use the v2 per-shard format "
+            f"(flexflow_tpu/ckpt), which each host writes/reads "
+            f"through the same shared directory without a rank-0 "
+            f"funnel.")
 
-    Returns the saved iteration counter. Shapes must match the compiled
-    model (same graph); shardings may differ — arrays are re-placed with
-    the current strategy's NamedShardings.
+
+def load_checkpoint(path: str, ffmodel) -> int:
+    """Restore a checkpoint onto the live shardings (v1 or v2).
+
+    ``path`` may be a v1 file stem (``<stem>.npz`` + manifest) or a v2
+    per-shard checkpoint directory (a root of ``step_*`` dirs, or one
+    step dir) — the format is auto-detected, so resume tooling needs
+    one entry point for both. Returns the saved iteration counter.
+    Shapes must match the compiled model (same graph); shardings may
+    differ — arrays are re-placed with the current strategy's
+    NamedShardings, including onto a different mesh (elastic resume).
+    Missing or partial checkpoints fail fast on every rank.
     """
+    # the FORMAT decision itself is per-host filesystem state, so it
+    # must be agreed before ranks diverge into different loaders (each
+    # with its own collective): a v2 root visible only on some ranks
+    # would otherwise pair a step-number gather on one rank with a
+    # visibility-flag gather on another — mixed-meaning values in one
+    # collective, the ADVICE r5 class all over again
+    is_dir = os.path.isdir(path)
+    if jax.process_count() > 1:
+        from flexflow_tpu import distributed
+        seen, agree = distributed.ranks_agree(1 if is_dir else 0)
+        if not agree:
+            bad = [r for r, v in enumerate(seen) if not v]
+            raise FileNotFoundError(
+                f"checkpoint path '{path}' is a v2 directory on some "
+                f"ranks but not on rank(s) {bad} (per-rank view: "
+                f"{seen}) — the checkpoint must be on a filesystem "
+                f"shared by every host (GCS/NFS)")
+    if is_dir:
+        from flexflow_tpu.ckpt import load_sharded
+        return load_sharded(path, ffmodel)
+    _check_visible(path)
     npz_path = path if path.endswith(".npz") else path + ".npz"
     with open(_manifest_path(path)) as f:
         manifest = json.load(f)
     data = np.load(npz_path)
-    flat = {k: data[k] for k in manifest["array_keys"]}
-    flat.update(manifest["scalars"])
-    state = _rebuild(manifest["structure"], flat)
+    dtypes = manifest.get("dtypes", {})
 
-    # re-place arrays on the shardings of the live values
-    def place(live, new):
-        if isinstance(live, dict):
-            if not isinstance(new, dict) or set(new) != set(live):
-                raise ValueError(
-                    f"checkpoint structure mismatch: expected keys "
-                    f"{sorted(live)}, found "
-                    f"{sorted(new) if isinstance(new, dict) else type(new)}")
-            return {k: place(live[k], new[k]) for k in live}
-        if isinstance(live, (list, tuple)):
-            if not isinstance(new, (list, tuple)) or len(new) != len(live):
-                raise ValueError(
-                    f"checkpoint structure mismatch: expected sequence of "
-                    f"{len(live)}, found {new!r:.80}")
-            rebuilt = [place(l, n) for l, n in zip(live, new)]
-            return type(live)(rebuilt) if isinstance(live, tuple) else rebuilt
-        if hasattr(live, "sharding") and hasattr(new, "shape"):
-            if tuple(live.shape) != tuple(np.shape(new)):
-                raise ValueError(
-                    f"checkpoint shape {np.shape(new)} != live {live.shape}")
-            # cast to the live dtype (bf16 opt state is saved as f32)
-            import jax.numpy as jnp
-            if jax.process_count() > 1:
-                # every host loads the full array; each places only its
-                # addressable shards of the (possibly cross-host)
-                # sharding. The callback returns numpy so JAX places
-                # each shard directly on its device (ml_dtypes covers
-                # bf16), with no default-device detour
-                arr = np.asarray(new)
-                dtype = np.dtype(live.dtype)
-                return jax.make_array_from_callback(
-                    tuple(live.shape), live.sharding,
-                    lambda idx: arr[idx].astype(dtype))
-            return jax.device_put(jnp.asarray(new, live.dtype), live.sharding)
-        return new
+    def _restore(k):
+        arr = data[k]
+        if k in dtypes:
+            from flexflow_tpu.ckpt.sharded import _np_dtype
+            arr = arr.view(_np_dtype(dtypes[k]))
+        return arr
+
+    flat = {k: _restore(k) for k in manifest["array_keys"]}
+    flat.update(manifest["scalars"])
+    state = rebuild_tree(manifest["structure"], flat)
 
     from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
     live_op_state = {k: v for k, v in ffmodel.state.items()
                      if k != COMPUTE_PARAMS_KEY}
-    ffmodel.params = place(ffmodel.params, state["params"])
-    ffmodel.opt_state = place(ffmodel.opt_state, state["opt_state"])
-    ffmodel.state = place(live_op_state, state["op_state"])
+    ffmodel.params = place_tree(ffmodel.params, state["params"])
+    ffmodel.opt_state = place_tree(ffmodel.opt_state, state["opt_state"])
+    ffmodel.state = place_tree(live_op_state, state["op_state"])
     ffmodel._compute_params_dirty = True
     ffmodel._refresh_compute_params()
     ffmodel._iter = int(manifest["iteration"])
+    if manifest.get("rng"):
+        import jax.numpy as jnp
+        ffmodel._rng = jnp.asarray(np.asarray(manifest["rng"],
+                                              dtype=np.uint32))
     return ffmodel._iter

@@ -1,0 +1,93 @@
+"""Preemption-aware elastic resume planning.
+
+The PCG + strategy decode make resume onto a DIFFERENT topology cheap
+for this framework: the checkpoint stores logically-global arrays (a
+shard index over the saving mesh) plus the searched strategy it ran
+under, and ``FFModel.compile`` already knows how to search a strategy
+for whatever devices survived. Resume is therefore a strategy decision,
+not a crash:
+
+* same device count → reuse the recorded strategy verbatim (write it to
+  a strategy file and compile with ``import_strategy_file`` — zero
+  search cost, identical shardings, bit-identical continuation);
+* different device count → compile with a search budget for the
+  surviving topology; ``load_sharded`` then reassembles each global
+  array from the shard index and re-places it onto the NEW strategy's
+  NamedShardings.
+
+``plan_resume`` encodes that decision; the multihost dryrun's
+kill-and-resume legs and scripts/ckpt_inspect.py consume it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from flexflow_tpu.ckpt import manifest as mf
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    """Manifest of the newest complete checkpoint under ``path`` (or of
+    the specific step dir). Raises FileNotFoundError when none exists —
+    never returns a partial checkpoint's view."""
+    step_dir = mf.resolve_step_dir(path)
+    if step_dir is None:
+        raise FileNotFoundError(
+            f"no complete checkpoint under '{path}' (a checkpoint is "
+            f"complete only once its {mf.MANIFEST_NAME} exists)")
+    manifest = mf.read_json(os.path.join(step_dir, mf.MANIFEST_NAME))
+    if manifest is None:
+        raise FileNotFoundError(f"unreadable manifest in {step_dir}")
+    return manifest
+
+
+def plan_resume(manifest: Dict[str, Any],
+                num_devices: int) -> Dict[str, Any]:
+    """Decide how the surviving topology resumes from ``manifest``.
+
+    Returns ``{action, saved_mesh, saved_devices, num_devices}`` with
+    ``action`` one of:
+
+    * ``"reuse"``    — device count matches the saving mesh: the
+      recorded strategy applies verbatim (``write_saved_strategy`` +
+      ``FFConfig.import_strategy_file``);
+    * ``"research"`` — topology changed: compile with a search budget
+      so the native search picks a strategy for what survived, then
+      load re-shards from the checkpointed shard index.
+    """
+    saved_mesh = {k: int(v) for k, v in (manifest.get("mesh") or {}).items()}
+    saved_devices = int(manifest.get("num_devices") or
+                        _prod(saved_mesh.values()))
+    action = "reuse" if saved_devices == int(num_devices) else "research"
+    return dict(action=action, saved_mesh=saved_mesh,
+                saved_devices=saved_devices, num_devices=int(num_devices))
+
+
+def write_saved_strategy(manifest: Dict[str, Any], path: str) -> str:
+    """Materialize the checkpoint's recorded strategy as a strategy
+    file (the ``--import-strategy`` format) for the same-topology
+    fast path. Returns ``path``."""
+    import json
+    strategy = manifest.get("strategy")
+    if not strategy:
+        raise ValueError("checkpoint manifest carries no strategy record")
+    with open(path, "w") as f:
+        json.dump(strategy, f, indent=1)
+    return path
+
+
+def strategy_matches_mesh(manifest: Dict[str, Any], mesh) -> bool:
+    """Whether the live mesh equals the saving mesh (axes and extents).
+    False just means the elastic re-shard path engages — not an error
+    (the FFL804 INFO diagnostic)."""
+    saved = {k: int(v) for k, v in (manifest.get("mesh") or {}).items()}
+    live = {k: int(v) for k, v in zip(mesh.axis_names, mesh.devices.shape)}
+    return saved == live
+
+
+def _prod(vals) -> int:
+    out = 1
+    for v in vals:
+        out *= int(v)
+    return out

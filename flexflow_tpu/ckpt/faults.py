@@ -1,0 +1,126 @@
+"""Deterministic fault injection for the elastic-training harness.
+
+``FFS_FAULT`` holds a comma-separated list of fault specs; each names
+an injection seam the checkpoint/runtime code calls at well-defined
+points, so a dryrun can kill a host mid-epoch, corrupt a shard on disk,
+or slow the writer — deterministically, without patching internals:
+
+* ``kill_host:<rank>@step:<n>`` — process ``rank`` exits hard (no
+  cleanup, exit code ``KILL_EXIT``) right after finishing global step
+  ``n`` — the preemption/hardware-loss simulation. The seam is
+  ``step_hook(step)``, called once per training step.
+* ``corrupt_shard:<key_substr>@step:<n>`` — during the save of step
+  ``n``, the serialized bytes of the first shard whose leaf path
+  contains ``key_substr`` are bit-flipped AFTER its checksum was
+  computed — the on-disk rot the integrity verifier must catch. Seam:
+  ``corrupt_bytes(leaf_key, step, payload)``.
+* ``slow_write:<ms>`` — every shard-file write sleeps ``ms``
+  milliseconds first; exaggerates the writer latency so the async-path
+  tests can prove the hot loop does not pay it. Seam: ``write_delay()``.
+
+Parsing is cached per env-string so the per-step hook costs one dict
+lookup when ``FFS_FAULT`` is unset.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV = "FFS_FAULT"
+KILL_EXIT = 77  # distinguishable from python tracebacks (1) and signals
+
+
+class FaultPlan:
+    def __init__(self, kills: List[Tuple[int, int]],
+                 corrupts: List[Tuple[str, int]],
+                 slow_write_s: float):
+        self.kills = kills            # [(rank, step)]
+        self.corrupts = corrupts      # [(key_substr, step)]
+        self.slow_write_s = slow_write_s
+        self._corrupted = set()       # fire each corrupt spec once
+
+    def step_hook(self, step: int) -> None:
+        if not self.kills:
+            return
+        import jax
+        rank = jax.process_index()
+        for (r, s) in self.kills:
+            if r == rank and s == step:
+                print(f"[ffs_fault] kill_host: rank {rank} exiting at "
+                      f"step {step}", file=sys.stderr, flush=True)
+                os._exit(KILL_EXIT)
+
+    def corrupt_bytes(self, leaf_key: str, step: int,
+                      payload: bytes) -> bytes:
+        for i, (sub, s) in enumerate(self.corrupts):
+            if s == step and sub in leaf_key and i not in self._corrupted:
+                self._corrupted.add(i)
+                print(f"[ffs_fault] corrupt_shard: flipping a byte of "
+                      f"'{leaf_key}' at step {step}", file=sys.stderr,
+                      flush=True)
+                b = bytearray(payload)
+                b[len(b) // 2] ^= 0xFF
+                return bytes(b)
+        return payload
+
+    def write_delay(self) -> None:
+        if self.slow_write_s > 0:
+            time.sleep(self.slow_write_s)
+
+
+def _parse(spec: str) -> Optional[FaultPlan]:
+    kills: List[Tuple[int, int]] = []
+    corrupts: List[Tuple[str, int]] = []
+    slow = 0.0
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            head, _, tail = part.partition("@")
+            kind, _, arg = head.partition(":")
+            if kind == "kill_host":
+                kills.append((int(arg), _step_of(tail)))
+            elif kind == "corrupt_shard":
+                corrupts.append((arg, _step_of(tail)))
+            elif kind == "slow_write":
+                slow = float(arg) / 1e3
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                f"{ENV}={spec!r}: cannot parse fault {part!r} "
+                f"(expected kill_host:<rank>@step:<n>, "
+                f"corrupt_shard:<key>@step:<n>, or slow_write:<ms>): {e}"
+            ) from None
+    if not (kills or corrupts or slow):
+        return None
+    return FaultPlan(kills, corrupts, slow)
+
+
+def _step_of(tail: str) -> int:
+    kind, _, v = tail.partition(":")
+    if kind != "step":
+        raise ValueError(f"expected @step:<n>, got @{tail!r}")
+    return int(v)
+
+
+_CACHE: Dict[str, Optional[FaultPlan]] = {}
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active fault plan (None when ``FFS_FAULT`` is unset/empty).
+    Re-reads the env each call; parsing is memoized per spec string."""
+    spec = os.environ.get(ENV, "")
+    if not spec:
+        return None
+    if spec not in _CACHE:
+        _CACHE[spec] = _parse(spec)
+    return _CACHE[spec]
+
+
+def step_hook(step: int) -> None:
+    """Per-training-step seam (kill_host). No-op without ``FFS_FAULT``."""
+    plan = get_plan()
+    if plan is not None:
+        plan.step_hook(step)

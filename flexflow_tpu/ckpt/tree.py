@@ -1,0 +1,129 @@
+"""Pytree <-> flat-key plumbing shared by both checkpoint formats.
+
+One flatten/skeleton/rebuild/place implementation serves the legacy v1
+single-file path (flexflow_tpu/checkpoint.py) and the v2 per-shard
+package (flexflow_tpu/ckpt/sharded.py): '/'-joined key paths over any
+nesting of dict/list/tuple with array leaves, a JSON-able structure
+skeleton, and re-placement of restored arrays onto the live values'
+NamedShardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def flatten_tree(tree, prefix="") -> List[Tuple[str, Any]]:
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            out += flatten_tree(tree[k], f"{prefix}{k}/")
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for i, v in enumerate(tree):
+            out += flatten_tree(v, f"{prefix}{i}/")
+        return out
+    return [(prefix[:-1], tree)]
+
+
+def tree_structure(tree):
+    """JSON-able skeleton used to rebuild nesting on load."""
+    if isinstance(tree, dict):
+        return {"__kind__": "dict",
+                "items": {k: tree_structure(v) for k, v in tree.items()}}
+    if isinstance(tree, tuple):
+        return {"__kind__": "tuple",
+                "items": [tree_structure(v) for v in tree]}
+    if isinstance(tree, list):
+        return {"__kind__": "list",
+                "items": [tree_structure(v) for v in tree]}
+    return {"__kind__": "leaf"}
+
+
+def rebuild_tree(skel, flat: Dict[str, Any], prefix=""):
+    kind = skel["__kind__"]
+    if kind == "dict":
+        return {k: rebuild_tree(v, flat, f"{prefix}{k}/")
+                for k, v in skel["items"].items()}
+    if kind in ("list", "tuple"):
+        seq = [rebuild_tree(v, flat, f"{prefix}{i}/")
+               for i, v in enumerate(skel["items"])]
+        return tuple(seq) if kind == "tuple" else seq
+    return flat[prefix[:-1]]
+
+
+def _same_shifted_names(live: Dict[str, Any], new: Dict[str, Any]) -> bool:
+    """True when two key sets agree after stripping trailing _<guid>
+    counters from auto-generated op names — the build-a-second-model-
+    in-one-process footgun, worth its own diagnosis."""
+    def stem(k: str) -> str:
+        base, _, tail = k.rpartition("_")
+        return base if base and tail.isdigit() else k
+
+    return (len(live) == len(new)
+            and sorted(map(stem, live)) == sorted(map(stem, new)))
+
+
+def place_tree(live, new):
+    """Re-place a restored tree onto the shardings of the live values.
+
+    Structure and per-leaf global shapes must match; shardings may
+    differ — each array lands on the LIVE leaf's NamedSharding (this is
+    what makes resume onto a re-searched strategy / different mesh a
+    plain load). Restored leaves are cast to the live dtype.
+    """
+    import jax
+
+    if isinstance(live, dict):
+        if not isinstance(new, dict) or set(new) != set(live):
+            hint = ""
+            if isinstance(new, dict) and _same_shifted_names(live, new):
+                hint = (
+                    " — the op names differ only by their auto-name "
+                    "counters: auto-generated names (linear_7, ...) are "
+                    "deterministic for a fresh process rebuilding the "
+                    "same script (a normal restart), but NOT for a "
+                    "second model built in one process; pass explicit "
+                    "name= to the ops to make checkpoint keys "
+                    "build-order-independent")
+            raise ValueError(
+                f"checkpoint structure mismatch: expected keys "
+                f"{sorted(live)}, found "
+                f"{sorted(new) if isinstance(new, dict) else type(new)}"
+                f"{hint}")
+        return {k: place_tree(live[k], new[k]) for k in live}
+    if isinstance(live, (list, tuple)):
+        if not isinstance(new, (list, tuple)) or len(new) != len(live):
+            raise ValueError(
+                f"checkpoint structure mismatch: expected sequence of "
+                f"{len(live)}, found {new!r:.80}")
+        rebuilt = [place_tree(l, n) for l, n in zip(live, new)]
+        return type(live)(rebuilt) if isinstance(live, tuple) else rebuilt
+    if hasattr(live, "sharding") and hasattr(new, "shape"):
+        if tuple(live.shape) != tuple(np.shape(new)):
+            raise ValueError(
+                f"checkpoint shape {np.shape(new)} != live {live.shape}")
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        if not isinstance(live.sharding, NamedSharding):
+            # a default-placed (uncommitted) leaf, e.g. the optimizer's
+            # step counter: re-placing onto its SingleDeviceSharding
+            # would COMMIT it to one device and poison the next jitted
+            # step's device agreement — hand jit an uncommitted array
+            return jnp.asarray(np.asarray(new), live.dtype)
+        if jax.process_count() > 1:
+            # every host holds the assembled global array; each places
+            # only its addressable shards of the (possibly cross-host)
+            # sharding. The callback returns numpy so JAX places each
+            # shard directly on its device (ml_dtypes covers bf16),
+            # with no default-device detour
+            arr = np.asarray(new)
+            dtype = np.dtype(live.dtype)
+            return jax.make_array_from_callback(
+                tuple(live.shape), live.sharding,
+                lambda idx: arr[idx].astype(dtype))
+        return jax.device_put(jnp.asarray(new, live.dtype), live.sharding)
+    return new

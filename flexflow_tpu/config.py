@@ -139,6 +139,21 @@ class FFConfig:
     # StepTracer Perfetto timeline. Needs --trace-dir (artifacts land
     # there). None = no capture.
     profile_steps: Optional[str] = None
+    # v2 per-shard async checkpointing (flexflow_tpu/ckpt): when a
+    # directory is set, fit saves every --checkpoint-every iterations
+    # plus once at end-of-run (a directory with no cadence still gets
+    # that final checkpoint — never a silently-empty resume target) —
+    # each host writes only its addressable shards, off the critical
+    # path, with a manifest-last commit record — keeping the newest
+    # --checkpoint-retain complete checkpoints. --resume restores the
+    # newest complete checkpoint first (empty dir = fresh launch; a
+    # partial-only dir fails fast on every rank), so one command line
+    # serves the first start and every preemption restart.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
+    checkpoint_retain: int = 3
+    checkpoint_async: bool = True
+    resume: bool = False
 
     @property
     def num_devices(self) -> int:
@@ -291,6 +306,23 @@ class FFConfig:
                         f"--weight-update-sharding expects auto|on|off, "
                         f"got {v!r}")
                 self.weight_update_sharding = v
+            elif a == "--checkpoint-dir":
+                self.checkpoint_dir = take()
+            elif a == "--checkpoint-every":
+                self.checkpoint_every = int(take())
+            elif a == "--checkpoint-retain":
+                v = int(take())
+                if v < 1:
+                    raise ValueError(
+                        f"--checkpoint-retain expects >= 1 (the last "
+                        f"complete checkpoint is never deleted), got {v}")
+                self.checkpoint_retain = v
+            elif a == "--checkpoint-sync":
+                # A/B escape hatch: commit on the training thread (the
+                # async writer is the default)
+                self.checkpoint_async = False
+            elif a == "--resume":
+                self.resume = True
             elif a == "--lint":
                 v = take().lower()
                 if v not in ("off", "warn", "error"):

@@ -131,6 +131,17 @@ def allgather_value(value: int):
     return [int(v) for v in np.asarray(gathered).ravel()]
 
 
+def ranks_agree(value: int) -> Tuple[list, bool]:
+    """(per-rank values, all-equal?) for a host-side scalar — the
+    checkpoint fail-fast primitive (ADVICE r5): decisions derived from
+    per-host filesystem state (is the checkpoint visible? which step is
+    newest?) must be compared across ranks BEFORE anyone enters the
+    load's collectives, or a non-shared filesystem turns into a silent
+    deadlock. Single process: ([value], True)."""
+    vals = allgather_value(value)
+    return vals, len(set(vals)) == 1
+
+
 # ---------------------------------------------------------------------------
 # per-host batch staging
 

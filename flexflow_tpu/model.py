@@ -993,9 +993,46 @@ class FFModel:
         except Exception as e:
             print(f"[obs] counter export failed: {e!r}", file=sys.stderr)
 
+    def _make_checkpointer(self, checkpoint_dir, checkpoint_every, resume,
+                           run_name: str = "fit"):
+        """CheckpointManager for one fit call (None when checkpointing
+        is off). Explicit arguments win over the ``--checkpoint-*`` /
+        ``--resume`` config flags. With resume on, the newest COMPLETE
+        checkpoint restores (fail-fast on every rank when the directory
+        holds only partial ones) and the returned start step tells the
+        epoch loop how many step slots to skip; an empty directory is a
+        fresh launch — the same command line serves first start and
+        every restart."""
+        cfg = self.config
+        cdir = checkpoint_dir or cfg.checkpoint_dir
+        do_resume = resume if resume is not None else cfg.resume
+        every = (checkpoint_every if checkpoint_every is not None
+                 else cfg.checkpoint_every)
+        if not cdir:
+            if do_resume:
+                raise ValueError(
+                    "resume requested but no checkpoint directory — pass "
+                    "fit(checkpoint_dir=...) or --checkpoint-dir")
+            if every:
+                # a cadence with nowhere to write would train for hours
+                # saving nothing — the silent-data-loss launch typo
+                raise ValueError(
+                    f"checkpoint_every={every} requested but no checkpoint "
+                    f"directory — pass fit(checkpoint_dir=...) or "
+                    f"--checkpoint-dir")
+            return None, 0
+        from flexflow_tpu.ckpt import CheckpointManager
+        mgr = CheckpointManager(self, cdir, every=every,
+                                retain=cfg.checkpoint_retain,
+                                async_write=cfg.checkpoint_async,
+                                run_name=run_name)
+        start = mgr.resume() if do_resume else 0
+        return mgr, start
+
     def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
                     verbose: bool, on_epoch_start=None, tracer=None,
-                    devtrace=None) -> float:
+                    devtrace=None, ckpt_mgr=None, start_step: int = 0,
+                    skip_fetch: bool = False) -> float:
         """Shared epoch loop: per-batch jitted step, on-device metric
         accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
         report. ``next_batch(epoch, b)`` -> (inputs dict, labels).
@@ -1008,7 +1045,18 @@ class FFModel:
         device_put spans — disjoint, so phase totals sum to step time
         instead of double-booking H2D under data_load), and each epoch
         ends with a metrics_sync span (the one host fetch of the
-        accumulated metrics)."""
+        accumulated metrics).
+
+        ``ckpt_mgr`` (a flexflow_tpu.ckpt.CheckpointManager) saves every
+        ``checkpoint_every`` iterations (blocking only for the local
+        device→host shard snapshot; file writes and the manifest commit
+        run on its writer thread) and once more at the end. A resumed
+        run passes ``start_step``: the first ``start_step`` step slots
+        of the epoch grid are skipped — the slots the checkpoint already
+        covers — so epochs/batch indices line up with the uninterrupted
+        schedule (``skip_fetch`` fetches-and-discards skipped batches
+        for loaders that advance positional state)."""
+        from flexflow_tpu.ckpt import faults as _faults
         from flexflow_tpu.obs import NULL_CAPTURE, NULL_TRACER
         tracer = tracer or NULL_TRACER
         devtrace = devtrace or NULL_CAPTURE
@@ -1016,14 +1064,21 @@ class FFModel:
         self._refresh_compute_params()
         start = time.time()
         loss = None
+        executed = 0
         step_idx = -1  # global step index, the --profile-steps coordinate
         for epoch in range(epochs):
             if on_epoch_start is not None:
                 on_epoch_start()
             self._metrics_acc = PerfMetrics()
             mtotals = None
+            epoch_executed = 0
             for b in range(num_batches):
                 step_idx += 1
+                if step_idx < start_step:
+                    # this step slot is inside the restored checkpoint
+                    if skip_fetch:
+                        next_batch(epoch, b)
+                    continue
                 # devtrace OUTSIDE tracer.step: the profiler session
                 # start/stop at the window edges costs whole seconds on
                 # some backends — observability overhead, not step time,
@@ -1043,16 +1098,39 @@ class FFModel:
                     if tracer.active or devtrace.active:
                         with tracer.phase("device_wait"):
                             jax.block_until_ready(loss)
+                executed += 1
+                epoch_executed += 1
+                # fault-injection seam (FFS_FAULT kill_host — the
+                # preemption simulation); no-op when the env is unset
+                _faults.step_hook(step_idx)
+                if ckpt_mgr is not None:
+                    if ckpt_mgr.should_save(self._iter):
+                        with tracer.phase("checkpoint"):
+                            ckpt_mgr.save(self._iter)
+                    else:
+                        ckpt_mgr.note_step(self._iter)
             with tracer.phase("metrics_sync", epoch=epoch):
-                self._metrics_acc.update(dict(mtotals or {}),
-                                         bs * num_batches)
-                self._last_loss = float(loss)
-            if verbose:
+                if epoch_executed:
+                    # a resumed run's partial epoch accumulated only the
+                    # EXECUTED steps' totals — average over those, not
+                    # the full grid
+                    self._metrics_acc.update(dict(mtotals or {}),
+                                             bs * epoch_executed)
+                    self._last_loss = float(loss)
+            if verbose and epoch_executed:
+                # fully-skipped epochs (inside the restored checkpoint)
+                # have nothing to report
                 rep = self._metrics_acc.report()
                 print(f"epoch {epoch}: loss={self._last_loss:.4f} " +
                       " ".join(f"{k}={v:.4f}" for k, v in rep.items()))
         elapsed = time.time() - start
-        thr = bs * num_batches * epochs / elapsed
+        if ckpt_mgr is not None:
+            # final save + durability barrier + goodput gauge: the run
+            # must not be reported done while a commit is still in flight
+            ckpt_mgr.finalize(elapsed_s=elapsed, steps=executed)
+        # throughput counts only the samples this run actually processed
+        # (a resume skips the checkpoint-covered step slots in ~0 time)
+        thr = bs * executed / elapsed
         if verbose:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
         return thr
@@ -1060,7 +1138,10 @@ class FFModel:
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, verbose: bool = True,
             trace_dir: Optional[str] = None,
-            profile_steps: Optional[str] = None):
+            profile_steps: Optional[str] = None,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: Optional[int] = None,
+            resume: Optional[bool] = None):
         """Keras-style whole-dataset training loop, streaming batches from
         host (base_model.py:376-430 / flexflow_cffi.py:2073-2086).
 
@@ -1074,7 +1155,18 @@ class FFModel:
         additionally wraps that step window in a ``jax.profiler``
         capture: device compute/collective lanes and per-step
         compute/comms/exposed-comms attribution merge into the same
-        trace dir (obs/devtrace)."""
+        trace dir (obs/devtrace).
+
+        ``checkpoint_dir`` + ``checkpoint_every`` (or the
+        ``--checkpoint-*`` flags) turn on v2 per-shard async
+        checkpointing (flexflow_tpu/ckpt): every N iterations each host
+        snapshots its addressable shards (the only blocking cost) and a
+        writer thread commits them manifest-last, retaining the newest
+        ``--checkpoint-retain`` checkpoints. ``resume`` (or
+        ``--resume``) restores the newest complete checkpoint first and
+        skips the step slots it covers, so ``epochs`` keeps meaning the
+        TOTAL schedule — an interrupted and an uninterrupted run of the
+        same command line end bit-identically."""
         epochs = epochs or self.config.epochs
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
@@ -1098,12 +1190,17 @@ class FFModel:
                 return (self._stage_inputs(xs_np),
                         self._shard_batch(y_np))
 
-        # a traced run that dies mid-training (OOM, NaN assert, ^C)
-        # still flushes its trace — that trace is the diagnosis
+        # a traced run that dies mid-training (OOM, NaN assert, ^C) —
+        # or at resume, against a missing/corrupt checkpoint — still
+        # flushes its trace: that trace is the diagnosis
         try:
+            ckpt_mgr, start_step = self._make_checkpointer(
+                checkpoint_dir, checkpoint_every, resume,
+                run_name=tracer.run_name if tracer.active else "fit")
             out = self._run_epochs(next_batch, num_batches, bs, epochs,
                                    verbose, tracer=tracer,
-                                   devtrace=devtrace)
+                                   devtrace=devtrace, ckpt_mgr=ckpt_mgr,
+                                   start_step=start_step)
         except BaseException:
             self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise
@@ -1112,7 +1209,10 @@ class FFModel:
 
     def fit_loader(self, loaders, epochs: Optional[int] = None,
                    verbose: bool = True, trace_dir: Optional[str] = None,
-                   profile_steps: Optional[str] = None):
+                   profile_steps: Optional[str] = None,
+                   checkpoint_dir: Optional[str] = None,
+                   checkpoint_every: Optional[int] = None,
+                   resume: Optional[bool] = None):
         """Steady-state training from staged on-device loaders
         (flexflow_tpu.dataloader) — no host→device traffic per step."""
         epochs = epochs or self.config.epochs
@@ -1125,10 +1225,18 @@ class FFModel:
                 return loaders.next_batch()
 
         try:
+            ckpt_mgr, start_step = self._make_checkpointer(
+                checkpoint_dir, checkpoint_every, resume,
+                run_name=tracer.run_name if tracer.active else "fit")
+            # skip_fetch: the staged loader advances positional state —
+            # a resumed run must consume (and discard) the covered
+            # batches so the post-resume stream lines up
             out = self._run_epochs(next_batch, loaders.num_batches, bs,
                                    epochs, verbose,
                                    on_epoch_start=loaders.reset,
-                                   tracer=tracer, devtrace=devtrace)
+                                   tracer=tracer, devtrace=devtrace,
+                                   ckpt_mgr=ckpt_mgr,
+                                   start_step=start_step, skip_fetch=True)
         except BaseException:
             self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise

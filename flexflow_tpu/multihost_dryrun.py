@@ -259,6 +259,385 @@ def _free_port() -> int:
     return port
 
 
+# ---------------------------------------------------------------------------
+# elastic fault-tolerance legs (ISSUE 10): kill a host mid-epoch via the
+# FFS_FAULT harness, then resume from the last complete checkpoint on
+# (a) the same mesh — bit-identical loss continuity — and (b) a smaller
+# mesh through a re-searched strategy (resume is a strategy decision).
+
+
+def _worker_env(trace_dir: Optional[str] = None) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["FFS_MP_CHILD"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    # the per-process backend is configured inside the worker via jax
+    # config (not env), so a sitecustomize cannot override it
+    env.pop("XLA_FLAGS", None)
+    env.pop("FFS_FAULT", None)
+    if trace_dir:
+        env["FFS_TRACE_DIR"] = trace_dir
+    else:
+        env.pop("FFS_TRACE_DIR", None)
+    return env
+
+
+def _spawn(entry: str, num_processes: int, devices_per_proc: int,
+           outs, extra_args, env, timeout: int, tolerate_failures: bool,
+           kill_grace: float = 30.0):
+    """Spawn the rendezvous participants for one leg and wait.
+
+    ``tolerate_failures`` is the fault-injection mode: the first worker
+    to die does NOT fail the leg; its peers get ``kill_grace`` seconds
+    to exit (they are mid-collective with a dead peer — gloo may error
+    out or hang) and are then killed. Returns the exit-code list."""
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = []
+    try:
+        for p in range(num_processes):
+            code = (
+                "import sys; sys.path.insert(0, %r); "
+                "from flexflow_tpu.multihost_dryrun import %s; "
+                "%s(%d, %d, %d, %d, %s)"
+                % (repo, entry, entry, p, num_processes, port,
+                   devices_per_proc,
+                   ", ".join(repr(a) for a in [outs[p]] + list(extra_args)))
+            )
+            procs.append(subprocess.Popen([sys.executable, "-c", code],
+                                          cwd=repo, env=env))
+        if not tolerate_failures:
+            return [proc.wait(timeout=timeout) for proc in procs]
+        deadline = _time.monotonic() + timeout
+        first_death = None
+        while _time.monotonic() < deadline:
+            codes = [proc.poll() for proc in procs]
+            if all(c is not None for c in codes):
+                return codes
+            if any(c is not None for c in codes):
+                if first_death is None:
+                    first_death = _time.monotonic()
+                elif _time.monotonic() - first_death > kill_grace:
+                    break  # survivors are wedged on the dead peer
+            _time.sleep(0.1)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        return [proc.poll() for proc in procs]
+    finally:
+        # a worker that died pre-rendezvous leaves its peer blocked in
+        # jax.distributed.initialize — never orphan it
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def _elastic_train_loop(ff, lx, ly, start: int, steps: int, mgr=None):
+    """The manual iteration protocol with the checkpoint-manager and
+    fault seams fit() uses, returning the per-step losses — the loss
+    series the continuity assertions compare bitwise."""
+    from flexflow_tpu.ckpt import faults
+
+    losses = []
+    ff.set_batch(lx, ly)
+    for step in range(start, steps):
+        ff.forward()
+        ff.backward()
+        ff.update()
+        losses.append(float(ff._last_loss))
+        faults.step_hook(step)
+        if mgr is not None:
+            if mgr.should_save(ff._iter):
+                mgr.save(ff._iter)
+            else:
+                mgr.note_step(ff._iter)
+    return losses
+
+
+def elastic_worker_main(process_id: int, num_processes: int, port: int,
+                        devices_per_proc: int, out_path: str,
+                        ckpt_dir: str, steps: int, every: int,
+                        resume: int) -> None:
+    """One participant of an elastic-training leg: train the dryrun
+    model step by step with per-shard async checkpointing, honoring the
+    FFS_FAULT plan the parent set (kill_host mid-epoch), optionally
+    resuming from the newest complete checkpoint first."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import distributed
+
+    distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+    total = jax.device_count()
+    ff = _build(total)
+    cfg = _model_config(total)
+    x, y = _global_batch(cfg)
+    rows, lo = distributed.local_batch_rows(
+        ff.executor.batch_sharding(), x.shape[0])
+    lx, ly = x[lo:lo + rows], y[lo:lo + rows]
+
+    mgr = None
+    start = 0
+    if ckpt_dir:
+        from flexflow_tpu.ckpt import CheckpointManager
+        mgr = CheckpointManager(ff, ckpt_dir, every=every, retain=3,
+                                async_write=True, run_name="dryrun",
+                                fs_timeout=60.0)
+        if resume:
+            start = mgr.resume(require=True)
+    losses = _elastic_train_loop(ff, lx, ly, start, steps, mgr)
+    if mgr is not None:
+        mgr.finalize(elapsed_s=None, steps=None)
+    np.savez(out_path, losses=np.asarray(losses, np.float64),
+             start=np.int64(start))
+
+
+def failfast_worker_main(process_id: int, num_processes: int, port: int,
+                         devices_per_proc: int, out_path: str,
+                         base_dir: str) -> None:
+    """Regression worker for the ADVICE r5 hang: every rank points at a
+    RANK-PRIVATE checkpoint path (simulating a non-shared filesystem
+    where only rank 0 can see the files rank 0 wrote). Both the v1 and
+    the v2 load must raise the same actionable error on EVERY rank —
+    promptly — instead of FileNotFoundError on some ranks and a
+    collective deadlock on the rest."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import distributed
+
+    distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+    total = jax.device_count()
+    ff = _build(total)
+    my_dir = os.path.join(base_dir, f"rank{process_id}")
+    os.makedirs(my_dir, exist_ok=True)
+    # v1: a collective save whose files land only under rank 0's view
+    v1_stem = os.path.join(my_dir, "ckpt_v1")
+    ff.save_checkpoint(os.path.join(base_dir, "rank0", "ckpt_v1")
+                       if process_id == 0 else v1_stem + "_unwritten")
+    results = {}
+    try:
+        ff.load_checkpoint(v1_stem)
+        results["v1"] = "no error"
+    except FileNotFoundError as e:
+        results["v1"] = f"FileNotFoundError: {e}"
+    # v2: rank 0 sees a real checkpoint, rank 1 an empty directory
+    from flexflow_tpu.ckpt import load_sharded, save_sharded
+    shared = os.path.join(base_dir, "shared_v2")
+    save_sharded(shared, ff)  # all ranks participate; genuinely shared
+    probe = shared if process_id == 0 else my_dir
+    try:
+        load_sharded(probe, ff)
+        results["v2"] = "no error"
+    except FileNotFoundError as e:
+        results["v2"] = f"FileNotFoundError: {e}"
+    np.savez(out_path, **{k: np.str_(v) for k, v in results.items()})
+
+
+def run_ckpt_failfast_dryrun(num_processes: int = 2,
+                             devices_per_proc: int = 1,
+                             timeout: int = 240) -> None:
+    """Assert the non-shared-filesystem load fails fast on every rank
+    (ADVICE r5 regression): both format loaders must raise
+    FileNotFoundError naming the invisible ranks, and the whole leg
+    must finish well inside the timeout (the old behavior was an
+    unbounded hang)."""
+    with tempfile.TemporaryDirectory() as td:
+        outs = [os.path.join(td, f"ff{p}.npz") for p in range(num_processes)]
+        rcs = _spawn("failfast_worker_main", num_processes,
+                     devices_per_proc, outs, [os.path.join(td, "ckpts")],
+                     _worker_env(), timeout, tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(
+                f"ckpt fail-fast dryrun: worker exit codes {rcs}")
+        for p, out in enumerate(outs):
+            got = {k: str(v) for k, v in np.load(out).items()}
+            for fmt in ("v1", "v2"):
+                if not got[fmt].startswith("FileNotFoundError"):
+                    raise AssertionError(
+                        f"worker {p} {fmt} load did not fail fast: "
+                        f"{got[fmt]!r}")
+                if "shared" not in got[fmt]:
+                    raise AssertionError(
+                        f"worker {p} {fmt} error is not actionable "
+                        f"(no shared-filesystem hint): {got[fmt]!r}")
+    print(f"ckpt fail-fast dryrun ok: {num_processes} ranks, both "
+          f"formats raise actionable FileNotFoundError, no hang")
+
+
+def run_elastic_dryrun(num_processes: int = 2, devices_per_proc: int = 1,
+                       steps: int = 6, every: int = 2, kill_step: int = 4,
+                       timeout: int = 240) -> dict:
+    """Kill-and-resume end to end.
+
+    Phase A: an uninterrupted N-process run records the reference loss
+    series. Phase B: the same run with ``FFS_FAULT=kill_host:<last
+    rank>@step:<kill_step>`` and per-shard async checkpointing — the
+    killed host exits hard mid-epoch, the survivors are reaped, and the
+    directory must hold a complete (manifest-committed) checkpoint and
+    nothing readable beyond it. ``kill_step`` must leave at least one
+    save() call strictly between the first checkpointed iteration and
+    the kill: save() joins the PREVIOUS async writer on the training
+    thread, so that earlier checkpoint is deterministically committed
+    before the kill can fire — the leg never depends on a writer
+    thread racing the (millisecond) training steps. Phase C: resume on
+    the SAME mesh — the
+    continued loss series must be bit-identical to the reference from
+    the restored step on. Phase D (in-process): resume on a SMALLER
+    mesh (half the devices) — ``plan_resume`` says "research", the
+    native search (when available) picks a strategy for the surviving
+    topology, and the reassembled state trains on with losses matching
+    the reference to reduction-order tolerance. Returns a summary dict.
+    """
+    import jax
+
+    total = num_processes * devices_per_proc
+    kill_rank = num_processes - 1
+    summary = {}
+    with tempfile.TemporaryDirectory() as td:
+        ckpt_dir = os.path.join(td, "ckpts")
+
+        # ---- phase A: uninterrupted reference ---------------------------
+        outs = [os.path.join(td, f"ref{p}.npz") for p in range(num_processes)]
+        rcs = _spawn("elastic_worker_main", num_processes, devices_per_proc,
+                     outs, ["", steps, every, 0], _worker_env(), timeout,
+                     tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(f"elastic dryrun reference: exit codes {rcs}")
+        ref = np.load(outs[0])["losses"]
+        if len(ref) != steps or not np.all(np.isfinite(ref)):
+            raise AssertionError(f"reference losses malformed: {ref}")
+
+        # ---- phase B: kill a host mid-epoch -----------------------------
+        from flexflow_tpu.ckpt.faults import KILL_EXIT
+        env = _worker_env()
+        env["FFS_FAULT"] = f"kill_host:{kill_rank}@step:{kill_step}"
+        outs_b = [os.path.join(td, f"fault{p}.npz")
+                  for p in range(num_processes)]
+        rcs = _spawn("elastic_worker_main", num_processes, devices_per_proc,
+                     outs_b, [ckpt_dir, steps, every, 0], env, timeout,
+                     tolerate_failures=True)
+        if rcs[kill_rank] != KILL_EXIT:
+            raise AssertionError(
+                f"fault leg: rank {kill_rank} was meant to die with exit "
+                f"{KILL_EXIT} at step {kill_step}, got exit codes {rcs}")
+        from flexflow_tpu.ckpt import latest_complete, verify_step_dir
+        latest = latest_complete(ckpt_dir)
+        if latest is None:
+            raise AssertionError(
+                "fault leg left no complete checkpoint — the pre-kill "
+                "saves never committed")
+        resume_step, step_dir = latest
+        if resume_step > kill_step + 1:
+            raise AssertionError(
+                f"complete checkpoint at iteration {resume_step} claims "
+                f"steps after the kill at step {kill_step}")
+        rep = verify_step_dir(step_dir)
+        if not rep["complete"]:
+            raise AssertionError(
+                f"latest checkpoint fails deep verification: "
+                f"{rep['errors']}")
+        summary["resume_step"] = resume_step
+
+        # ---- phase C: resume on the SAME mesh — bit-identical -----------
+        outs_c = [os.path.join(td, f"res{p}.npz")
+                  for p in range(num_processes)]
+        rcs = _spawn("elastic_worker_main", num_processes, devices_per_proc,
+                     outs_c, [ckpt_dir, steps, every, 1], _worker_env(),
+                     timeout, tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(f"elastic dryrun resume: exit codes {rcs}")
+        for p, out in enumerate(outs_c):
+            got = np.load(out)
+            start = int(got["start"])
+            if start != resume_step:
+                raise AssertionError(
+                    f"worker {p} resumed at {start}, expected "
+                    f"{resume_step}")
+            cont = got["losses"]
+            want = ref[start:]
+            if not np.array_equal(cont, want):
+                raise AssertionError(
+                    f"worker {p}: resumed losses diverge from the "
+                    f"uninterrupted run on the same mesh — not "
+                    f"bit-identical\n  resumed {cont}\n  expected {want}")
+        summary["same_mesh_bitwise"] = True
+
+        # ---- phase D: resume on a SMALLER mesh (re-searched) ------------
+        n_small = max(1, total // 2)
+        if len(jax.devices()) < n_small:
+            raise RuntimeError(
+                f"elastic dryrun needs {n_small} local devices for the "
+                f"smaller-mesh leg, have {len(jax.devices())}")
+        # phase C's resumed run has since committed newer checkpoints
+        # into the same directory — phase D must restart from the same
+        # post-kill state, so it targets the surviving step dir directly
+        from flexflow_tpu.ckpt import load_manifest, plan_resume
+        plan = plan_resume(load_manifest(step_dir), n_small)
+        if plan["action"] != "research":
+            raise AssertionError(
+                f"plan_resume on {n_small}/{plan['saved_devices']} devices "
+                f"should demand a re-search, got {plan}")
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.machine import make_mesh
+        from flexflow_tpu.models.transformer import create_transformer
+        from flexflow_tpu.optimizers import SGDOptimizer
+        from flexflow_tpu.search.native import available as _native_ok
+        cfg = _model_config(total)
+        budget = 6 if _native_ok() else 0
+        ff_small = create_transformer(
+            cfg, FFConfig(batch_size=cfg.batch_size,
+                          workers_per_node=n_small,
+                          search_budget=budget,
+                          enable_parameter_parallel=n_small > 1))
+        ff_small.compile(SGDOptimizer(lr=0.05),
+                         LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                         mesh=None if budget else make_mesh(
+                             n_small, {"data": n_small}))
+        mesh_small = dict(zip(ff_small.mesh.axis_names,
+                              ff_small.mesh.devices.shape))
+        it = ff_small.load_checkpoint(step_dir)
+        if it != resume_step:
+            raise AssertionError(
+                f"smaller-mesh load restored iteration {it}, expected "
+                f"{resume_step}")
+        x, y = _global_batch(cfg)
+        cont = _elastic_train_loop(ff_small, x, y, resume_step, steps)
+        if not np.all(np.isfinite(cont)):
+            raise AssertionError(
+                f"smaller-mesh resume produced non-finite losses: {cont}")
+        if not np.allclose(cont, ref[resume_step:], rtol=1e-3, atol=1e-5):
+            raise AssertionError(
+                f"smaller-mesh resumed losses diverged beyond reduction-"
+                f"order tolerance\n  resumed {cont}\n  "
+                f"expected {ref[resume_step:]}")
+        summary["smaller_mesh"] = mesh_small
+        summary["researched"] = bool(budget)
+    print(f"elastic dryrun ok: {num_processes}x{devices_per_proc} killed "
+          f"rank {kill_rank} at step {kill_step}, resumed from iteration "
+          f"{summary['resume_step']}: same-mesh continuation bit-identical"
+          f"; smaller mesh {summary['smaller_mesh']} "
+          f"({'re-searched strategy' if summary['researched'] else 'heuristic strategy'}) "
+          f"converges within tolerance")
+    return summary
+
+
 def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
                timeout: int = 600,
                trace_dir: Optional[str] = None,

@@ -412,10 +412,13 @@ def _memory_correction() -> float:
 
 # ---- strategy files (--export-strategy / --import-strategy) ---------------
 
-def export_strategy_file(path: str, mesh_axes: Dict[str, int],
-                         strategy: Strategy, nodes) -> None:
-    """Serialize a strategy keyed by op *name* (stable across runs, unlike
-    guids — the reference keys by FFConfig::get_hash_id, strategy.cc:26)."""
+def strategy_json(mesh_axes: Dict[str, int], strategy: Strategy,
+                  nodes) -> Dict[str, Any]:
+    """Strategy keyed by op *name* (stable across runs, unlike guids —
+    the reference keys by FFConfig::get_hash_id, strategy.cc:26) as a
+    JSON-able dict: the body of a strategy file, also embedded verbatim
+    in v2 checkpoint manifests (flexflow_tpu/ckpt) so a same-topology
+    resume can reuse the searched strategy without re-searching."""
     by_guid = {n.op.guid: n.op.name for n in nodes}
     ops = {}
     for guid, st in strategy.items():
@@ -427,8 +430,13 @@ def export_strategy_file(path: str, mesh_axes: Dict[str, int],
             outputs=[list(s) if s is not None else None for s in st.output_specs],
             params={k: list(v) for k, v in st.param_specs.items()},
         )
+    return dict(version=1, mesh=dict(mesh_axes), ops=ops)
+
+
+def export_strategy_file(path: str, mesh_axes: Dict[str, int],
+                         strategy: Strategy, nodes) -> None:
     with open(path, "w") as f:
-        json.dump(dict(version=1, mesh=mesh_axes, ops=ops), f, indent=1)
+        json.dump(strategy_json(mesh_axes, strategy, nodes), f, indent=1)
 
 
 def import_strategy_file(path: str, nodes) -> Tuple[Dict[str, int], Strategy]:

@@ -64,5 +64,16 @@ sys.exit(1 if missing else 0)
 EOF
 ovl_rc=$?
 if [ "$ovl_rc" -ne 0 ]; then echo "OBS overlap fields: $(cat /tmp/_t1_ovl.out) — non-fatal"; else echo "OBS overlap fields: ok"; fi
+# Elasticity stage (ISSUE 10, non-fatal): the tier-1-fast kill-and-resume
+# leg — 2 processes x 1 device, a host killed mid-epoch via FFS_FAULT,
+# resume from the last complete per-shard checkpoint on the same mesh
+# (bit-identical losses) and on a smaller mesh (re-searched strategy).
+# The same leg runs inside the pytest gate (tests/test_multihost.py);
+# this stage re-exercises it standalone so its output lands in the log.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -c "
+from flexflow_tpu.multihost_dryrun import run_elastic_dryrun
+run_elastic_dryrun(num_processes=2, devices_per_proc=1)
+" > /tmp/_t1_elastic.out 2>&1; elastic_rc=$?
+if [ "$elastic_rc" -ne 0 ]; then echo "ELASTIC: kill/resume leg failed (exit $elastic_rc, see /tmp/_t1_elastic.out) — non-fatal"; else echo "ELASTIC: $(grep -a 'elastic dryrun ok' /tmp/_t1_elastic.out | head -1)"; fi
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
 exit $rc
