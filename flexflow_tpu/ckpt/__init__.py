@@ -22,7 +22,8 @@ manager.
 from flexflow_tpu.ckpt.elastic import (load_manifest, plan_resume,
                                        strategy_matches_mesh,
                                        write_saved_strategy)
-from flexflow_tpu.ckpt.faults import FaultPlan, get_plan, step_hook
+from flexflow_tpu.ckpt.faults import (FaultPlan, get_plan, io_check,
+                                      step_hook)
 from flexflow_tpu.ckpt.manager import CheckpointManager
 from flexflow_tpu.ckpt.manifest import (collect_garbage, latest_complete,
                                         list_steps, resolve_step_dir,
@@ -35,6 +36,7 @@ __all__ = [
     "FaultPlan",
     "collect_garbage",
     "get_plan",
+    "io_check",
     "latest_complete",
     "list_steps",
     "load_manifest",

@@ -19,11 +19,13 @@ writer errors are re-raised on the training thread at the next
 worse than a loud crash.
 
 Goodput accounting: ``finalize`` publishes ``<run>/goodput_effective``
-= productive time / (wall + restart-lost time), where checkpoint stalls
-count against the numerator and the steps lost to the last preemption
-(restored iteration vs the rank-0 PROGRESS heartbeat) are priced at the
-run's own mean step time. This is the ratchet coordinate for the
-elastic-training direction.
+= productive time / (wall + restart-lost time + supervisor downtime),
+where checkpoint stalls count against the numerator, the steps lost to
+the last preemption (restored iteration vs the rank-0 PROGRESS
+heartbeat) are priced at the run's own mean step time, and the restart
+backoff a ``scripts/supervise.py`` session spent (SUPERVISOR.json's
+``downtime_s``) lands in the denominator. This is the ratchet
+coordinate for the elastic-training direction.
 """
 
 from __future__ import annotations
@@ -44,7 +46,8 @@ _PROGRESS_INTERVAL_S = 0.5
 class CheckpointManager:
     def __init__(self, ffmodel, directory: str, every: int = 0,
                  retain: int = 3, async_write: bool = True,
-                 run_name: str = "fit", fs_timeout: float = 120.0):
+                 run_name: str = "fit", fs_timeout: float = 120.0,
+                 heartbeat=None, state_provider=None):
         if not directory:
             raise ValueError("CheckpointManager needs a checkpoint directory")
         self.ff = ffmodel
@@ -54,6 +57,12 @@ class CheckpointManager:
         self.async_write = bool(async_write)
         self.run_name = run_name
         self.fs_timeout = float(fs_timeout)
+        # watchdog feed (flexflow_tpu/runtime_health.py): writer-thread
+        # progress marks — a long commit is progress, not a hang
+        self.heartbeat = heartbeat
+        # JSON-able client state recorded in every manifest (the
+        # dataloader cursor travels here; fit_loader sets it)
+        self.state_provider = state_provider
         self.restart_lost_steps = 0
         self._last_saved_iter = -1
         self._stall_total_s = 0.0
@@ -132,7 +141,15 @@ class CheckpointManager:
         exists to expose."""
         t0 = time.perf_counter()
         self._join_pending()
-        snap = sharded.snapshot(self.ff, step=iteration)
+        client_state = None
+        if self.state_provider is not None:
+            try:
+                client_state = self.state_provider()
+            except Exception as e:
+                print(f"[ckpt] state_provider failed (manifest will carry "
+                      f"no client_state): {e!r}", file=sys.stderr)
+        snap = sharded.snapshot(self.ff, step=iteration,
+                                client_state=client_state)
         self._last_saved_iter = snap.step
         if self.async_write:
             self._pending = threading.Thread(
@@ -153,8 +170,11 @@ class CheckpointManager:
     def _commit(self, snap) -> None:
         t0 = time.perf_counter()
         try:
+            if self.heartbeat is not None:
+                self.heartbeat(f"ckpt commit start step {snap.step}")
             nbytes = sharded.write_snapshot(self.directory, snap,
-                                            fs_timeout=self.fs_timeout)
+                                            fs_timeout=self.fs_timeout,
+                                            heartbeat=self.heartbeat)
             reg = get_registry()
             reg.observe(f"{self.run_name}/ckpt_async_write_s",
                         time.perf_counter() - t0)
@@ -202,9 +222,22 @@ class CheckpointManager:
             productive = max(0.0, elapsed_s - self._stall_total_s)
             per_step = productive / max(1, steps)
             lost_s = self.restart_lost_steps * per_step
-            goodput = productive / max(elapsed_s + lost_s, 1e-12)
-            get_registry().gauge(f"{self.run_name}/goodput_effective",
-                                 max(0.0, min(1.0, goodput)))
+            # a run living under scripts/supervise.py also pays the
+            # supervisor's restart backoff — that downtime belongs in
+            # the goodput denominator, not hidden outside the metric
+            reg = get_registry()
+            sup_downtime = 0.0
+            sup = mf.read_supervisor(self.directory)
+            if sup:
+                sup_downtime = float(sup.get("downtime_s") or 0.0)
+                reg.gauge(f"{self.run_name}/supervisor_restarts",
+                          float(sup.get("restarts") or 0))
+                reg.gauge(f"{self.run_name}/supervisor_downtime_s",
+                          sup_downtime)
+            goodput = productive / max(elapsed_s + lost_s + sup_downtime,
+                                       1e-12)
+            reg.gauge(f"{self.run_name}/goodput_effective",
+                      max(0.0, min(1.0, goodput)))
 
     @property
     def save_stall_s(self) -> float:

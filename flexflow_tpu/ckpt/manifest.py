@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MANIFEST_NAME = "MANIFEST.json"
 PROGRESS_NAME = "PROGRESS.json"
+SUPERVISOR_NAME = "SUPERVISOR.json"
 STEP_RE = re.compile(r"^step_(\d{8})$")
 CKPT_VERSION = 2
 
@@ -64,6 +65,8 @@ def atomic_replace(path: str, mode: str = "wb"):
     body unlinks the tmp and never touches the destination. The ONE
     implementation of the crash-atomicity protocol — the v1 .npz, the
     v2 shard files, and every JSON record go through here."""
+    from flexflow_tpu.ckpt import faults
+    faults.io_check(path)  # the io_error transient-failure seam
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_",
@@ -292,3 +295,11 @@ def note_progress(directory: str, iteration: int) -> None:
 def read_progress(directory: str) -> int:
     data = read_json(os.path.join(directory, PROGRESS_NAME))
     return int(data["iteration"]) if data and "iteration" in data else -1
+
+
+def read_supervisor(directory: str) -> Optional[Dict[str, Any]]:
+    """The supervisor's state record (scripts/supervise.py), when this
+    run lives under one — restart counts and cumulative backoff
+    downtime, which ``CheckpointManager.finalize`` folds into
+    ``goodput_effective``."""
+    return read_json(os.path.join(directory, SUPERVISOR_NAME))

@@ -19,6 +19,22 @@ surviving topology happens in ``FFModel.compile``; see
 flexflow_tpu/ckpt/elastic.py for the planning helpers). bfloat16 leaves
 are stored as uint16 bit-views with the true dtype in the manifest, so
 restore is bit-exact.
+
+Restore is also RANK-LOCAL in the common same-mesh case: for each leaf
+the loader intersects the saved shard index with the live model's
+addressable shard boxes and reads + CRC-verifies only the shards this
+host actually needs — a saved box that exactly matches a needed box is
+read, one that doesn't touch the needed region is skipped, and any
+partial overlap (the mesh changed) falls back to the full scan for
+that leaf. Cuts restore cost by ~the host count; the read/skip byte
+split lands in the ``ckpt/restore_read_bytes`` /
+``ckpt/restore_skipped_bytes`` obs counters.
+
+Writes absorb transient filesystem blips with bounded
+retry-with-backoff (``FFS_CKPT_IO_RETRIES`` retries, exponential from
+``FFS_CKPT_IO_BACKOFF_S``; each retry bumps the ``ckpt/io_retries``
+counter); a retry-exhausted error propagates with the underlying
+``OSError`` intact so the manager can surface it at the next ``save``.
 """
 
 from __future__ import annotations
@@ -33,6 +49,36 @@ from flexflow_tpu.ckpt import faults
 from flexflow_tpu.ckpt import manifest as mf
 from flexflow_tpu.ckpt.tree import (flatten_tree, place_tree, rebuild_tree,
                                     tree_structure)
+
+
+def _retry_io(what: str, fn, heartbeat=None):
+    """Run ``fn`` (an atomic write), absorbing transient ``OSError``\\ s
+    with bounded exponential backoff. ``FFS_CKPT_IO_RETRIES`` (default
+    3) bounds the retries, ``FFS_CKPT_IO_BACKOFF_S`` (default 0.05)
+    seeds the delay; each retry bumps ``ckpt/io_retries``. Exhausted
+    retries re-raise the LAST ``OSError`` unchanged — the caller (the
+    async writer) must surface the true cause, not a wrapper."""
+    import sys
+
+    retries = int(os.environ.get("FFS_CKPT_IO_RETRIES", "3"))
+    backoff = float(os.environ.get("FFS_CKPT_IO_BACKOFF_S", "0.05"))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = backoff * (2.0 ** attempt)
+            attempt += 1
+            from flexflow_tpu.obs.registry import get_registry
+            get_registry().inc("ckpt/io_retries")
+            print(f"[ckpt] transient I/O error writing {what}: {e!r} — "
+                  f"retry {attempt}/{retries} in {delay * 1e3:.0f}ms",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
+            if heartbeat is not None:
+                heartbeat(f"ckpt io retry {attempt}")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -99,10 +145,14 @@ class ShardSnapshot:
             a.nbytes for entries in shards.values() for _, a in entries)
 
 
-def snapshot(ffmodel, step: Optional[int] = None) -> ShardSnapshot:
+def snapshot(ffmodel, step: Optional[int] = None,
+             client_state: Optional[Dict[str, Any]] = None) -> ShardSnapshot:
     """Blocking device→host copy of this host's shards (the only part
     of a save that must run on the training thread — the next step's
-    dispatch donates the buffers we are reading)."""
+    dispatch donates the buffers we are reading). ``client_state`` is
+    an arbitrary JSON-able dict recorded verbatim in the manifest —
+    the dataloader cursor (epoch/batch position) travels here so a
+    resume can seek instead of skip-fetching."""
     import jax
 
     step = int(ffmodel._iter if step is None else step)
@@ -160,18 +210,22 @@ def snapshot(ffmodel, step: Optional[int] = None) -> ShardSnapshot:
                                ffmodel.executor.nodes),
         wall_unix=time.time(),
     )
+    if client_state is not None:
+        extra["client_state"] = client_state
     return ShardSnapshot(step, pidx, pcnt, shards, leaves,
                          tree_structure(state), scalars, extra)
 
 
 def write_snapshot(directory: str, snap: ShardSnapshot,
-                   fs_timeout: float = 120.0) -> int:
+                   fs_timeout: float = 120.0, heartbeat=None) -> int:
     """Write this host's shard + index files and run the commit
     protocol (rank 0 writes the manifest last after every host's index
     is visible; every rank returns only once the manifest exists — the
     durability barrier). Safe to run on a background thread: no JAX
-    collectives, filesystem polling only. Returns this host's payload
-    bytes."""
+    collectives, filesystem polling only. Transient write errors retry
+    with backoff (``_retry_io``). ``heartbeat`` (when the run carries a
+    watchdog) marks each completed file as writer progress — a long
+    commit is not a hang. Returns this host's payload bytes."""
     step_dir = os.path.join(directory, mf.step_dir_name(snap.step))
     os.makedirs(step_dir, exist_ok=True)
     plan = faults.get_plan()
@@ -200,16 +254,27 @@ def write_snapshot(directory: str, snap: ShardSnapshot,
 
     shards_file = mf.shards_name(snap.process_index)
     spath = os.path.join(step_dir, shards_file)
-    with mf.atomic_replace(spath) as f:
-        if plan is not None:
-            plan.write_delay()
-        np.savez(f, **arrays)
+
+    def _write_shards():
+        with mf.atomic_replace(spath) as f:
+            if plan is not None:
+                plan.write_delay()
+            np.savez(f, **arrays)
+
+    _retry_io(shards_file, _write_shards, heartbeat=heartbeat)
+    if heartbeat is not None:
+        heartbeat(f"ckpt shards step {snap.step}")
     # index AFTER the shard data it references is durable
-    mf.atomic_write_json(
-        os.path.join(step_dir, mf.index_name(snap.process_index)),
-        dict(version=mf.CKPT_VERSION, step=snap.step,
-             host=snap.process_index, shards_file=shards_file,
-             shards=index))
+    index_path = os.path.join(step_dir, mf.index_name(snap.process_index))
+    _retry_io(mf.index_name(snap.process_index),
+              lambda: mf.atomic_write_json(
+                  index_path,
+                  dict(version=mf.CKPT_VERSION, step=snap.step,
+                       host=snap.process_index, shards_file=shards_file,
+                       shards=index)),
+              heartbeat=heartbeat)
+    if heartbeat is not None:
+        heartbeat(f"ckpt index step {snap.step}")
 
     index_files = [mf.index_name(h) for h in range(snap.process_count)]
     if snap.process_index == 0:
@@ -227,8 +292,10 @@ def write_snapshot(directory: str, snap: ShardSnapshot,
             num_hosts=snap.process_count,
             **snap.manifest_extra,
         )
-        mf.atomic_write_json(os.path.join(step_dir, mf.MANIFEST_NAME),
-                             manifest)
+        _retry_io(mf.MANIFEST_NAME,
+                  lambda: mf.atomic_write_json(
+                      os.path.join(step_dir, mf.MANIFEST_NAME), manifest),
+                  heartbeat=heartbeat)
     # durability barrier: no rank observes the save as complete before
     # the commit record exists
     mf.wait_for_files([os.path.join(step_dir, mf.MANIFEST_NAME)],
@@ -248,6 +315,73 @@ def save_sharded(directory: str, ffmodel, step: Optional[int] = None,
 
 # ---------------------------------------------------------------------------
 # load
+
+
+def _box_volume(box, shape=None) -> int:
+    """Elements inside a serialized shard box ([] = a 0-d scalar)."""
+    if not box:
+        return int(np.prod(shape)) if shape else 1
+    return int(np.prod([max(0, b[1] - b[0]) for b in box]))
+
+
+def _boxes_intersect(a, b) -> bool:
+    for (s1, e1), (s2, e2) in zip(a, b):
+        if min(e1, e2) <= max(s1, s2):
+            return False
+    return True
+
+
+def _live_boxes(ffmodel) -> Dict[str, Optional[List[List[List[int]]]]]:
+    """Per-leaf deduplicated addressable shard boxes of the LIVE
+    model's arrays — the regions THIS host must restore. ``None``
+    marks a leaf the planner cannot reason about (host-resident numpy
+    op state) — those take the full scan."""
+    out: Dict[str, Optional[List[List[List[int]]]]] = {}
+    for key, v in flatten_tree(_capture_state(ffmodel)):
+        boxes = None
+        if hasattr(v, "addressable_shards") and hasattr(v, "sharding"):
+            try:
+                boxes, seen = [], set()
+                for sh in v.addressable_shards:
+                    box = _box(sh.index, v.shape)
+                    t = tuple(map(tuple, box))
+                    if t not in seen:
+                        seen.add(t)
+                        boxes.append(box)
+            except Exception:
+                boxes = None
+        out[key] = boxes
+    return out
+
+
+def _select_rows(entries, needed):
+    """The rank-local read plan for one leaf.
+
+    ``entries`` are (shards_file, row) pairs from every host's index;
+    ``needed`` the live addressable boxes (None = unknowable). Returns
+    ``(selected, skipped, want_elements, rank_local)``. Rank-local mode
+    engages only when every saved box either EXACTLY matches a needed
+    box or misses the needed region entirely — the same-mesh case. Any
+    partial overlap means the mesh changed; that leaf falls back to the
+    full scan (``want_elements=None`` → caller uses the global count),
+    which reassembles the whole array exactly as before."""
+    if needed is None:
+        return entries, [], None, False
+    needed_keys = {tuple(map(tuple, b)) for b in needed}
+    selected, skipped = [], []
+    for ent in entries:
+        box = ent[1]["index"]
+        t = tuple(map(tuple, box))
+        if t in needed_keys:
+            selected.append(ent)
+        elif any(_boxes_intersect(box, nb) for nb in needed):
+            # boxes changed (elastic resume onto a different mesh):
+            # correctness over savings — read everything for this leaf
+            return entries, [], None, False
+        else:
+            skipped.append(ent)
+    want = sum(_box_volume(nb) for nb in needed)
+    return selected, skipped, want, True
 
 
 def _gather_agree(value: int, what: str) -> int:
@@ -279,15 +413,21 @@ def _gather_agree(value: int, what: str) -> int:
     return seen[0]
 
 
-def load_sharded(path: str, ffmodel, verify: bool = True) -> int:
+def load_sharded(path: str, ffmodel, verify: bool = True,
+                 rank_local: bool = True) -> int:
     """Restore a v2 per-shard checkpoint onto the live model.
 
     ``path`` is a checkpoint root (newest complete step is taken) or a
     specific ``step_*`` directory. Works across mesh shapes and host
     counts: each global array is reassembled from the shard index and
     re-placed onto the live strategy's NamedShardings. Missing or
-    partial checkpoints raise on EVERY rank. Returns the restored
-    iteration counter."""
+    partial checkpoints raise on EVERY rank. ``rank_local`` (default)
+    reads + CRC-verifies only the shards whose boxes this host's live
+    arrays actually cover, falling back per-leaf to the full scan when
+    the saved boxes don't line up with the live ones (mesh changed).
+    Returns the restored iteration counter."""
+    from flexflow_tpu.obs.registry import get_registry
+
     step_dir = mf.resolve_step_dir(path)
     local = -1 if step_dir is None else _read_step(step_dir)
     step = _gather_agree(
@@ -303,10 +443,19 @@ def load_sharded(path: str, ffmodel, verify: bool = True) -> int:
     flat: Dict[str, Any] = dict(manifest.get("scalars", {}))
     pending: Dict[str, np.ndarray] = {}
     filled: Dict[str, int] = {}
+    want: Dict[str, int] = {}
+    local_mode: Dict[str, bool] = {}
     for leaf_key, meta in manifest["leaves"].items():
         pending[leaf_key] = np.empty([int(d) for d in meta["shape"]],
                                      dtype=_np_dtype(meta["saved_dtype"]))
         filled[leaf_key] = 0
+        want[leaf_key] = (int(np.prod(meta["shape"]))
+                          if meta["shape"] else 1)
+        local_mode[leaf_key] = False
+
+    # gather every host's index rows BEFORE reading any shard bytes, so
+    # the rank-local planner sees each leaf's complete saved shard set
+    rows_by_leaf: Dict[str, List] = {k: [] for k in manifest["leaves"]}
     for idx_file in manifest["index_files"]:
         index = mf.read_json(os.path.join(step_dir, idx_file))
         if index is None:
@@ -314,10 +463,34 @@ def load_sharded(path: str, ffmodel, verify: bool = True) -> int:
                 f"checkpoint {step_dir} is incomplete: shard index "
                 f"{idx_file} is missing/unreadable despite a manifest — "
                 f"refusing a partial restore")
-        npz = np.load(os.path.join(step_dir, index["shards_file"]))
         for leaf_key, rows in index["shards"].items():
-            dest = pending[leaf_key]
-            for row in rows:
+            rows_by_leaf.setdefault(leaf_key, []).extend(
+                (index["shards_file"], row) for row in rows)
+
+    live = _live_boxes(ffmodel) if rank_local else {}
+    reg = get_registry()
+    read_bytes = skipped_bytes = 0
+    # plan per-leaf first (the rank-local selection needs each leaf's
+    # complete shard set), then read FILE-major: a full-scan restore of
+    # an N-host checkpoint must hold at most ONE host's npz (and file
+    # descriptor) open at a time
+    reads_by_file: Dict[str, List] = {}
+    for leaf_key, entries in rows_by_leaf.items():
+        selected, skipped, leaf_want, is_local = _select_rows(
+            entries, live.get(leaf_key))
+        if is_local:
+            want[leaf_key] = leaf_want
+            local_mode[leaf_key] = True
+            skipped_bytes += sum(int(row.get("bytes", 0))
+                                 for _, row in skipped)
+        for shards_file, row in selected:
+            reads_by_file.setdefault(shards_file, []).append(
+                (leaf_key, row))
+    for shards_file, rows in reads_by_file.items():
+        npz = np.load(os.path.join(step_dir, shards_file))
+        try:
+            for leaf_key, row in rows:
+                dest = pending[leaf_key]
                 try:
                     data = np.ascontiguousarray(npz[row["key"]])
                 except Exception as e:  # zip-level CRC / truncation
@@ -334,6 +507,7 @@ def load_sharded(path: str, ffmodel, verify: bool = True) -> int:
                             f"(stored {int(row['crc32']):#010x}, recomputed "
                             f"{crc:#010x}) — on-disk corruption; refusing "
                             f"to restore")
+                read_bytes += int(row.get("bytes", data.nbytes))
                 box = row["index"]
                 if box:
                     sl = tuple(slice(b[0], b[1]) for b in box)
@@ -343,13 +517,19 @@ def load_sharded(path: str, ffmodel, verify: bool = True) -> int:
                 else:
                     dest[...] = data
                     filled[leaf_key] += 1
+        finally:
+            npz.close()
+    reg.inc("ckpt/restore_read_bytes", read_bytes)
+    reg.inc("ckpt/restore_skipped_bytes", skipped_bytes)
     for leaf_key, meta in manifest["leaves"].items():
-        want = int(np.prod(meta["shape"])) if meta["shape"] else 1
-        if filled[leaf_key] != want:
+        if filled[leaf_key] != want[leaf_key]:
+            scope = ("this host's live shard boxes"
+                     if local_mode[leaf_key] else "the global shape")
             raise ValueError(
                 f"checkpoint {step_dir}: leaf '{leaf_key}' reassembled "
-                f"{filled[leaf_key]}/{want} elements — incomplete shard "
-                f"set; refusing a partial restore")
+                f"{filled[leaf_key]}/{want[leaf_key]} elements of "
+                f"{scope} — incomplete shard set; refusing a partial "
+                f"restore")
         true = _np_dtype(meta["dtype"])
         if pending[leaf_key].dtype != true:
             pending[leaf_key] = pending[leaf_key].view(true)

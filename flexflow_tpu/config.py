@@ -154,6 +154,19 @@ class FFConfig:
     checkpoint_retain: int = 3
     checkpoint_async: bool = True
     resume: bool = False
+    # preemption-aware supervision (flexflow_tpu/runtime_health.py):
+    # --grace-window <s> installs a SIGTERM/SIGINT handler — the step
+    # loop finishes the in-flight step, cuts a final checkpoint through
+    # the CheckpointManager, finalizes traces, and exits PREEMPTED_EXIT
+    # (78), hard-exiting with the same code if the graceful path
+    # overruns the window. --watchdog-timeout <s> starts a heartbeat
+    # watchdog fed by the step loop and the checkpoint writer: no
+    # progress within the timeout dumps every thread stack and exits
+    # HUNG_EXIT (79) instead of blocking forever on a stuck collective.
+    # scripts/supervise.py classifies both codes and auto-restarts with
+    # --resume. 0 = off (the default: no handler, no thread).
+    grace_window_s: float = 0.0
+    watchdog_timeout_s: float = 0.0
 
     @property
     def num_devices(self) -> int:
@@ -323,6 +336,20 @@ class FFConfig:
                 self.checkpoint_async = False
             elif a == "--resume":
                 self.resume = True
+            elif a == "--grace-window":
+                v = float(take())
+                if v < 0:
+                    raise ValueError(
+                        f"--grace-window expects seconds >= 0 (0 = no "
+                        f"preemption handler), got {v}")
+                self.grace_window_s = v
+            elif a == "--watchdog-timeout":
+                v = float(take())
+                if v < 0:
+                    raise ValueError(
+                        f"--watchdog-timeout expects seconds >= 0 (0 = "
+                        f"no watchdog), got {v}")
+                self.watchdog_timeout_s = v
             elif a == "--lint":
                 v = take().lower()
                 if v not in ("off", "warn", "error"):

@@ -86,6 +86,18 @@ class SingleDataLoader:
     def reset(self) -> None:
         self.next_index = 0
 
+    def seek(self, batch_index: int) -> None:
+        """Position the loader AT ``batch_index`` (0-based within the
+        epoch) so the next ``next_batch`` returns that batch — the
+        resume path's O(1) reposition, replacing fetch-and-discard of
+        every checkpoint-covered batch."""
+        b = int(batch_index)
+        if not (0 <= b < self.num_batches):
+            raise ValueError(
+                f"seek({batch_index}) out of range for a loader with "
+                f"{self.num_batches} batches per epoch")
+        self.next_index = b * self._local_bs
+
     def next_batch(self, _ff=None):
         """Return the next batch, wrapping around (reference semantics:
         the C++ loader reloads from the start each epoch)."""
@@ -139,6 +151,13 @@ class DataLoaderSet:
         for l in self.input_loaders:
             l.reset()
         self.label_loader.reset()
+
+    def seek(self, batch_index: int) -> None:
+        """Reposition every loader at ``batch_index`` within the epoch
+        (fit_loader's resume seam)."""
+        for l in self.input_loaders:
+            l.seek(batch_index)
+        self.label_loader.seek(batch_index)
 
     def next_batch(self):
         inputs = {l.input_name: l.next_batch() for l in self.input_loaders}

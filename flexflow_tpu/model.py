@@ -993,8 +993,29 @@ class FFModel:
         except Exception as e:
             print(f"[obs] counter export failed: {e!r}", file=sys.stderr)
 
+    def _make_health(self, tracer, devtrace, run_name: str = "fit"):
+        """RuntimeHealth for one fit call (None when supervision is
+        off). ``--grace-window`` turns SIGTERM/SIGINT into a graceful
+        stop the step loop honors (final checkpoint + trace flush +
+        ``PREEMPTED_EXIT``); ``--watchdog-timeout`` starts the
+        hung-collective watchdog, whose trip flushes this run's trace
+        from the watchdog thread before ``HUNG_EXIT`` — the main
+        thread is wedged and will never reach its own finalizer."""
+        cfg = self.config
+        if cfg.grace_window_s <= 0 and cfg.watchdog_timeout_s <= 0:
+            return None
+        from flexflow_tpu.runtime_health import RuntimeHealth
+
+        def _flush_trace():
+            self._finalize_trace(tracer, success=False, devtrace=devtrace)
+
+        return RuntimeHealth(grace_window_s=cfg.grace_window_s,
+                             watchdog_timeout_s=cfg.watchdog_timeout_s,
+                             run_name=run_name, finalize_fn=_flush_trace)
+
     def _make_checkpointer(self, checkpoint_dir, checkpoint_every, resume,
-                           run_name: str = "fit"):
+                           run_name: str = "fit", heartbeat=None,
+                           state_provider=None):
         """CheckpointManager for one fit call (None when checkpointing
         is off). Explicit arguments win over the ``--checkpoint-*`` /
         ``--resume`` config flags. With resume on, the newest COMPLETE
@@ -1025,14 +1046,15 @@ class FFModel:
         mgr = CheckpointManager(self, cdir, every=every,
                                 retain=cfg.checkpoint_retain,
                                 async_write=cfg.checkpoint_async,
-                                run_name=run_name)
+                                run_name=run_name, heartbeat=heartbeat,
+                                state_provider=state_provider)
         start = mgr.resume() if do_resume else 0
         return mgr, start
 
     def _run_epochs(self, next_batch, num_batches: int, bs: int, epochs: int,
                     verbose: bool, on_epoch_start=None, tracer=None,
                     devtrace=None, ckpt_mgr=None, start_step: int = 0,
-                    skip_fetch: bool = False) -> float:
+                    on_resume=None, health=None) -> float:
         """Shared epoch loop: per-batch jitted step, on-device metric
         accumulation (one host sync per epoch), ELAPSED TIME / THROUGHPUT
         report. ``next_batch(epoch, b)`` -> (inputs dict, labels).
@@ -1054,8 +1076,17 @@ class FFModel:
         run passes ``start_step``: the first ``start_step`` step slots
         of the epoch grid are skipped — the slots the checkpoint already
         covers — so epochs/batch indices line up with the uninterrupted
-        schedule (``skip_fetch`` fetches-and-discards skipped batches
-        for loaders that advance positional state)."""
+        schedule. Skipped slots cost NOTHING: loaders with positional
+        state are repositioned by the one-shot ``on_resume(start_step)``
+        callback (fit_loader seeks its loaders there) instead of
+        fetching-and-discarding every covered batch.
+
+        ``health`` (flexflow_tpu.runtime_health.RuntimeHealth) is fed
+        once per finished step: the watchdog heartbeat, plus the
+        preemption check — a pending SIGTERM/maintenance notice raises
+        ``Preempted`` AFTER the in-flight step, at which point this
+        loop cuts the grace-window checkpoint (``ckpt_mgr.finalize``)
+        and lets the exception carry ``PREEMPTED_EXIT`` out."""
         from flexflow_tpu.ckpt import faults as _faults
         from flexflow_tpu.obs import NULL_CAPTURE, NULL_TRACER
         tracer = tracer or NULL_TRACER
@@ -1076,9 +1107,12 @@ class FFModel:
                 step_idx += 1
                 if step_idx < start_step:
                     # this step slot is inside the restored checkpoint
-                    if skip_fetch:
-                        next_batch(epoch, b)
                     continue
+                if step_idx == start_step and start_step and on_resume:
+                    # one-shot loader reposition: runs after this
+                    # epoch's on_epoch_start reset, right before the
+                    # first post-resume fetch
+                    on_resume(start_step)
                 # devtrace OUTSIDE tracer.step: the profiler session
                 # start/stop at the window edges costs whole seconds on
                 # some backends — observability overhead, not step time,
@@ -1100,9 +1134,29 @@ class FFModel:
                             jax.block_until_ready(loss)
                 executed += 1
                 epoch_executed += 1
-                # fault-injection seam (FFS_FAULT kill_host — the
-                # preemption simulation); no-op when the env is unset
+                # fault-injection seam (FFS_FAULT kill_host / sigterm /
+                # hang); no-op when the env is unset
                 _faults.step_hook(step_idx)
+                if health is not None:
+                    # watchdog heartbeat + preemption check. A pending
+                    # notice surfaces HERE — after the in-flight step —
+                    # so the grace checkpoint is a consistent post-step
+                    # state the auto-resumed run continues bit-exactly.
+                    try:
+                        health.step_done(step_idx)
+                    except BaseException:
+                        if ckpt_mgr is not None:
+                            t_grace = time.perf_counter()
+                            with tracer.phase("grace_checkpoint"):
+                                ckpt_mgr.finalize(
+                                    elapsed_s=time.time() - start,
+                                    steps=executed)
+                            from flexflow_tpu.obs.registry import \
+                                get_registry
+                            get_registry().gauge(
+                                f"{ckpt_mgr.run_name}/grace_checkpoint_s",
+                                time.perf_counter() - t_grace)
+                        raise
                 if ckpt_mgr is not None:
                     if ckpt_mgr.should_save(self._iter):
                         with tracer.phase("checkpoint"):
@@ -1190,20 +1244,29 @@ class FFModel:
                 return (self._stage_inputs(xs_np),
                         self._shard_batch(y_np))
 
-        # a traced run that dies mid-training (OOM, NaN assert, ^C) —
-        # or at resume, against a missing/corrupt checkpoint — still
-        # flushes its trace: that trace is the diagnosis
+        # a traced run that dies mid-training (OOM, NaN assert, ^C,
+        # preemption) — or at resume, against a missing/corrupt
+        # checkpoint — still flushes its trace: that trace is the
+        # diagnosis
+        run_name = tracer.run_name if tracer.active else "fit"
+        health = self._make_health(tracer, devtrace, run_name=run_name)
         try:
+            if health is not None:
+                health.install()
             ckpt_mgr, start_step = self._make_checkpointer(
                 checkpoint_dir, checkpoint_every, resume,
-                run_name=tracer.run_name if tracer.active else "fit")
+                run_name=run_name,
+                heartbeat=health.heartbeat if health is not None else None)
             out = self._run_epochs(next_batch, num_batches, bs, epochs,
                                    verbose, tracer=tracer,
                                    devtrace=devtrace, ckpt_mgr=ckpt_mgr,
-                                   start_step=start_step)
+                                   start_step=start_step, health=health)
         except BaseException:
             self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise
+        finally:
+            if health is not None:
+                health.close()
         self._finalize_trace(tracer, devtrace=devtrace)
         return out
 
@@ -1224,22 +1287,44 @@ class FFModel:
             with tracer.phase("data_load"):
                 return loaders.next_batch()
 
+        def cursor():
+            # the dataloader position, recorded in every manifest: a
+            # resume seeks straight here instead of fetching-and-
+            # discarding every covered batch (ROADMAP elastic (c))
+            nb = loaders.num_batches
+            return dict(loader=dict(iteration=int(self._iter),
+                                    epoch=int(self._iter // nb),
+                                    batch=int(self._iter % nb),
+                                    num_batches=int(nb)))
+
+        run_name = tracer.run_name if tracer.active else "fit"
+        health = self._make_health(tracer, devtrace, run_name=run_name)
         try:
+            if health is not None:
+                health.install()
             ckpt_mgr, start_step = self._make_checkpointer(
                 checkpoint_dir, checkpoint_every, resume,
-                run_name=tracer.run_name if tracer.active else "fit")
-            # skip_fetch: the staged loader advances positional state —
-            # a resumed run must consume (and discard) the covered
-            # batches so the post-resume stream lines up
+                run_name=run_name,
+                heartbeat=health.heartbeat if health is not None else None,
+                state_provider=cursor)
+            # the staged loader advances positional state — a resumed
+            # run repositions it once (seek) at the first post-resume
+            # slot, paying zero fetches for the covered ones
             out = self._run_epochs(next_batch, loaders.num_batches, bs,
                                    epochs, verbose,
                                    on_epoch_start=loaders.reset,
                                    tracer=tracer, devtrace=devtrace,
                                    ckpt_mgr=ckpt_mgr,
-                                   start_step=start_step, skip_fetch=True)
+                                   start_step=start_step,
+                                   on_resume=lambda s: loaders.seek(
+                                       s % loaders.num_batches),
+                                   health=health)
         except BaseException:
             self._finalize_trace(tracer, success=False, devtrace=devtrace)
             raise
+        finally:
+            if health is not None:
+                health.close()
         self._finalize_trace(tracer, devtrace=devtrace)
         return out
 

@@ -335,10 +335,14 @@ def _spawn(entry: str, num_processes: int, devices_per_proc: int,
                 proc.wait()
 
 
-def _elastic_train_loop(ff, lx, ly, start: int, steps: int, mgr=None):
-    """The manual iteration protocol with the checkpoint-manager and
-    fault seams fit() uses, returning the per-step losses — the loss
-    series the continuity assertions compare bitwise."""
+def _elastic_train_loop(ff, lx, ly, start: int, steps: int, mgr=None,
+                        health=None):
+    """The manual iteration protocol with the checkpoint-manager, fault
+    and supervision seams fit() uses, returning the per-step losses —
+    the loss series the continuity assertions compare bitwise.
+    ``health`` (a runtime_health.RuntimeHealth) is fed after every
+    step, exactly like fit's epoch loop: a pending preemption raises
+    ``Preempted`` out of here AFTER the in-flight step."""
     from flexflow_tpu.ckpt import faults
 
     losses = []
@@ -349,6 +353,8 @@ def _elastic_train_loop(ff, lx, ly, start: int, steps: int, mgr=None):
         ff.update()
         losses.append(float(ff._last_loss))
         faults.step_hook(step)
+        if health is not None:
+            health.step_done(step)
         if mgr is not None:
             if mgr.should_save(ff._iter):
                 mgr.save(ff._iter)
@@ -635,6 +641,303 @@ def run_elastic_dryrun(num_processes: int = 2, devices_per_proc: int = 1,
           f"; smaller mesh {summary['smaller_mesh']} "
           f"({'re-searched strategy' if summary['researched'] else 'heuristic strategy'}) "
           f"converges within tolerance")
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# preemption-aware supervision legs (ISSUE 12): SIGTERM mid-epoch must
+# yield a complete grace-window checkpoint and a bit-identical resume;
+# a hung step loop must be reaped by the watchdog and auto-restarted by
+# the supervisor; transient checkpoint-write failures must be absorbed
+# by retry-with-backoff.
+
+
+def preempted_worker_main(process_id: int, num_processes: int, port: int,
+                          devices_per_proc: int, out_path: str,
+                          ckpt_dir: str, steps: int, every: int,
+                          resume: int, grace: float) -> None:
+    """Elastic worker + RuntimeHealth: honors ``FFS_FAULT`` sigterm
+    specs, converts the signal into a grace-window final checkpoint,
+    and exits ``PREEMPTED_EXIT`` — the multi-host half of the graceful
+    preemption contract (every rank must still reach the commit
+    barrier inside the grace window)."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import distributed
+    from flexflow_tpu.runtime_health import (Preempted, PREEMPTED_EXIT,
+                                             RuntimeHealth)
+
+    distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+    total = jax.device_count()
+    ff = _build(total)
+    cfg = _model_config(total)
+    x, y = _global_batch(cfg)
+    rows, lo = distributed.local_batch_rows(
+        ff.executor.batch_sharding(), x.shape[0])
+    lx, ly = x[lo:lo + rows], y[lo:lo + rows]
+
+    from flexflow_tpu.ckpt import CheckpointManager
+    health = RuntimeHealth(grace_window_s=grace, run_name="dryrun")
+    mgr = CheckpointManager(ff, ckpt_dir, every=every, retain=3,
+                            async_write=True, run_name="dryrun",
+                            fs_timeout=60.0, heartbeat=health.heartbeat)
+    start = mgr.resume(require=True) if resume else 0
+    health.install()
+    try:
+        losses = _elastic_train_loop(ff, lx, ly, start, steps, mgr,
+                                     health=health)
+    except Preempted:
+        # the grace path: final checkpoint through the manager (every
+        # rank participates in the commit barrier), then the distinct
+        # exit code the supervisor classifies as "preempted"
+        mgr.finalize(elapsed_s=None, steps=None)
+        np.savez(out_path, losses=np.asarray([], np.float64),
+                 start=np.int64(start), preempted=np.int64(1))
+        health.close()
+        sys.exit(PREEMPTED_EXIT)
+    mgr.finalize(elapsed_s=None, steps=None)
+    health.close()
+    np.savez(out_path, losses=np.asarray(losses, np.float64),
+             start=np.int64(start), preempted=np.int64(0))
+
+
+def run_preemption_dryrun(num_processes: int = 2,
+                          devices_per_proc: int = 1, steps: int = 6,
+                          sigterm_step: int = 3,
+                          timeout: int = 240) -> dict:
+    """SIGTERM mid-epoch → grace-window checkpoint → bit-identical
+    auto-resume, across processes.
+
+    Phase A records the uninterrupted reference loss series. Phase B
+    delivers ``FFS_FAULT sigterm`` to EVERY rank at ``sigterm_step``
+    (the whole-slice preemption shape a platform maintenance event
+    takes): each rank finishes the in-flight step, the grace path cuts
+    a final checkpoint through the normal commit barrier, and every
+    rank exits ``PREEMPTED_EXIT``. Phase C resumes on the same mesh and
+    must continue bit-identically to the reference from the restored
+    iteration on."""
+    from flexflow_tpu.runtime_health import PREEMPTED_EXIT
+
+    summary = {}
+    with tempfile.TemporaryDirectory() as td:
+        ckpt_dir = os.path.join(td, "ckpts")
+
+        # ---- phase A: uninterrupted reference ---------------------------
+        outs = [os.path.join(td, f"ref{p}.npz") for p in range(num_processes)]
+        rcs = _spawn("elastic_worker_main", num_processes, devices_per_proc,
+                     outs, ["", steps, 0, 0], _worker_env(), timeout,
+                     tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(
+                f"preemption dryrun reference: exit codes {rcs}")
+        ref = np.load(outs[0])["losses"]
+        if len(ref) != steps or not np.all(np.isfinite(ref)):
+            raise AssertionError(f"reference losses malformed: {ref}")
+
+        # ---- phase B: SIGTERM every rank mid-epoch ----------------------
+        env = _worker_env()
+        env["FFS_FAULT"] = ",".join(
+            f"sigterm:{r}@step:{sigterm_step}" for r in range(num_processes))
+        outs_b = [os.path.join(td, f"pre{p}.npz")
+                  for p in range(num_processes)]
+        rcs = _spawn("preempted_worker_main", num_processes,
+                     devices_per_proc, outs_b,
+                     [ckpt_dir, steps, 0, 0, 60.0], env, timeout,
+                     tolerate_failures=False)
+        if rcs != [PREEMPTED_EXIT] * num_processes:
+            raise AssertionError(
+                f"preemption leg: every rank must exit PREEMPTED_EXIT "
+                f"({PREEMPTED_EXIT}), got {rcs}")
+        from flexflow_tpu.ckpt import latest_complete, verify_step_dir
+        latest = latest_complete(ckpt_dir)
+        if latest is None:
+            raise AssertionError(
+                "preemption leg left no complete checkpoint — the grace "
+                "window did not produce a committed save")
+        resume_step, step_dir = latest
+        if resume_step != sigterm_step + 1:
+            raise AssertionError(
+                f"grace checkpoint at iteration {resume_step}, expected "
+                f"{sigterm_step + 1} (the post-in-flight-step state)")
+        rep = verify_step_dir(step_dir)
+        if not rep["complete"]:
+            raise AssertionError(
+                f"grace checkpoint fails deep verification: "
+                f"{rep['errors']}")
+        summary["resume_step"] = resume_step
+
+        # ---- phase C: auto-resume, bit-identical ------------------------
+        outs_c = [os.path.join(td, f"res{p}.npz")
+                  for p in range(num_processes)]
+        rcs = _spawn("preempted_worker_main", num_processes,
+                     devices_per_proc, outs_c,
+                     [ckpt_dir, steps, 0, 1, 60.0], _worker_env(),
+                     timeout, tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(f"preemption dryrun resume: exit codes {rcs}")
+        for p, out in enumerate(outs_c):
+            got = np.load(out)
+            start = int(got["start"])
+            if start != resume_step:
+                raise AssertionError(
+                    f"worker {p} resumed at {start}, expected "
+                    f"{resume_step}")
+            cont = got["losses"]
+            want = ref[start:]
+            if not np.array_equal(cont, want):
+                raise AssertionError(
+                    f"worker {p}: post-preemption losses diverge from "
+                    f"the uninterrupted run — not bit-identical\n  "
+                    f"resumed {cont}\n  expected {want}")
+        summary["bitwise"] = True
+    print(f"preemption dryrun ok: {num_processes}x{devices_per_proc} "
+          f"SIGTERM at step {sigterm_step} -> complete grace checkpoint "
+          f"at iteration {summary['resume_step']}, resumed continuation "
+          f"bit-identical")
+    return summary
+
+
+_SUPERVISED_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+from flexflow_tpu.ffconst import ActiMode
+cfg = FFConfig(batch_size=64)
+rest = cfg.parse_args(sys.argv[1:])
+assert not rest, f"unparsed flags: {{rest}}"
+ff = FFModel(cfg)
+t = ff.create_tensor((64, 16))
+h = ff.dense(t, 32, activation=ActiMode.AC_MODE_RELU, name="h1")
+out = ff.dense(h, 4, name="out")
+ff.softmax(out)
+ff.compile(AdamOptimizer(alpha=0.01))
+rs = np.random.RandomState(0)
+x = rs.randn(256, 16).astype(np.float32)
+y = rs.randint(0, 4, 256).astype(np.int32).reshape(-1, 1)
+ff.fit(x, y, epochs=2, verbose=False)
+print("supervised child done: loss", float(ff._last_loss), flush=True)
+"""
+
+
+def run_supervised_dryrun(watchdog_timeout: float = 10.0) -> dict:
+    """Self-healing auto-resume, end to end, single process per
+    attempt: the Supervisor runs a real training subprocess through
+    the real ``fit`` wiring (``--watchdog-timeout``/``--grace-window``
+    flags), classifies the exit, and restarts with ``--resume``.
+
+    Leg 1 (hang): ``FFS_FAULT hang`` wedges the step loop — the
+    watchdog dumps stacks and exits ``HUNG_EXIT``; the supervised
+    restart (fault cleared: an injected fault models a one-time event)
+    resumes from the last complete checkpoint and finishes clean.
+    Leg 2 (kill): ``FFS_FAULT kill_host`` hard-kills mid-epoch; same
+    supervised recovery. Leg 3 (io_error, in-process): transient
+    checkpoint-write failures are absorbed by retry-with-backoff with
+    the retry count visible in obs counters."""
+    from flexflow_tpu.ckpt import latest_complete, verify_step_dir
+    from flexflow_tpu.ckpt import manifest as mf
+    from flexflow_tpu.runtime_health import Supervisor
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child_src = _SUPERVISED_CHILD.format(repo=repo)
+    summary = {}
+
+    def _run_leg(name, fault, ckpt_dir):
+        cmd = [sys.executable, "-c", child_src,
+               "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "2",
+               "--watchdog-timeout", str(watchdog_timeout),
+               "--grace-window", "60"]
+        env = _worker_env()
+        env["FFS_FAULT"] = fault
+        sup = Supervisor(cmd, max_restarts=2, backoff_base_s=0.2,
+                         backoff_max_s=2.0, env=env,
+                         state_path=os.path.join(ckpt_dir,
+                                                 mf.SUPERVISOR_NAME))
+        res = sup.run()
+        outcomes = [h["outcome"] for h in res["history"]]
+        if res["final_outcome"] != "clean":
+            raise AssertionError(
+                f"{name} leg: supervised run did not converge to clean "
+                f"(history {outcomes}, final code {res['final_code']})")
+        latest = latest_complete(ckpt_dir)
+        if latest is None or not verify_step_dir(latest[1])["complete"]:
+            raise AssertionError(
+                f"{name} leg: no complete checkpoint after supervised "
+                f"recovery")
+        sup_state = mf.read_supervisor(ckpt_dir)
+        if not sup_state or sup_state.get("restarts", 0) < 1:
+            raise AssertionError(
+                f"{name} leg: SUPERVISOR.json missing or records no "
+                f"restart: {sup_state}")
+        return outcomes
+
+    with tempfile.TemporaryDirectory() as td:
+        # ---- leg 1: hang -> watchdog HUNG_EXIT -> supervised restart ----
+        outcomes = _run_leg("hang", "hang:0@step:3",
+                            os.path.join(td, "hang"))
+        if outcomes[0] != "hung":
+            raise AssertionError(
+                f"hang leg: first attempt should be classified 'hung' "
+                f"(watchdog exit), got {outcomes}")
+        summary["hang"] = outcomes
+
+        # ---- leg 2: kill -> supervised auto-resume ----------------------
+        outcomes = _run_leg("kill", "kill_host:0@step:4",
+                            os.path.join(td, "kill"))
+        if outcomes[0] != "kill":
+            raise AssertionError(
+                f"kill leg: first attempt should be classified 'kill', "
+                f"got {outcomes}")
+        summary["kill"] = outcomes
+
+        # ---- leg 3: transient io_error -> retried save completes --------
+        from flexflow_tpu.ckpt import save_sharded
+        from flexflow_tpu.obs.registry import get_registry
+        ff = _build(1)
+        cfg = _model_config(1)
+        x, y = _global_batch(cfg)
+        ff.fit(x, y, epochs=1, verbose=False)
+        io_dir = os.path.join(td, "io")
+        reg = get_registry()
+        before = reg.get("ckpt/io_retries")
+        old = os.environ.get("FFS_FAULT")
+        from flexflow_tpu.ckpt import faults as _faults
+        # the parse cache memoizes FaultPlan per spec string and the
+        # io_error budget is mutable on the cached object — a stale
+        # (depleted) plan would inject nothing
+        _faults._CACHE.pop("io_error:shards_host:2", None)
+        os.environ["FFS_FAULT"] = "io_error:shards_host:2"
+        try:
+            save_sharded(io_dir, ff)
+        finally:
+            if old is None:
+                os.environ.pop("FFS_FAULT", None)
+            else:
+                os.environ["FFS_FAULT"] = old
+        retries = reg.get("ckpt/io_retries") - before
+        latest = latest_complete(io_dir)
+        if latest is None or not verify_step_dir(latest[1])["complete"]:
+            raise AssertionError(
+                "io_error leg: retried save did not produce a complete "
+                "checkpoint")
+        if retries != 2:
+            raise AssertionError(
+                f"io_error leg: expected 2 visible retries in obs "
+                f"counters, got {retries}")
+        summary["io_retries"] = int(retries)
+    print(f"supervised dryrun ok: hang {summary['hang']}, kill "
+          f"{summary['kill']} (auto-resumed to clean under the "
+          f"supervisor), io_error absorbed with {summary['io_retries']} "
+          f"retries")
     return summary
 
 

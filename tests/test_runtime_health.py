@@ -1,0 +1,629 @@
+"""Preemption-aware supervision (ISSUE 12).
+
+flexflow_tpu/runtime_health.py + the grown FFS_FAULT grammar: watchdog
+units on a fake clock (no real multi-second sleeps), the supervisor's
+exit-code classification table and restart/backoff loop with a fake
+runner, the in-process SIGTERM grace path through a real fit (complete
+grace-window checkpoint + PREEMPTED_EXIT + bitwise resume), transient
+io_error checkpoint writes absorbed by retry-with-backoff, the
+writer-error surfacing regression, rank-local restore's read planner +
+byte accounting, and the dataloader cursor (seek-on-resume, no
+redundant fetches). The multi-restart subprocess legs live @slow in
+tests/test_multihost.py; everything here is tier-1.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+from flexflow_tpu.ffconst import ActiMode
+from flexflow_tpu.ckpt import faults
+from flexflow_tpu.ckpt import manifest as mf
+from flexflow_tpu.ckpt import (CheckpointManager, latest_complete,
+                               load_manifest, load_sharded, save_sharded,
+                               verify_step_dir)
+from flexflow_tpu.obs.registry import get_registry
+from flexflow_tpu.runtime_health import (HUNG_EXIT, KILL_EXIT,
+                                         PREEMPTED_EXIT, Preempted,
+                                         PreemptionHandler, RuntimeHealth,
+                                         Supervisor, Watchdog,
+                                         classify_exit, dump_thread_stacks)
+
+
+def small_model(checkpoint_dir=None, grace=0.0, watchdog=0.0):
+    cfg = FFConfig(batch_size=64, checkpoint_dir=checkpoint_dir)
+    cfg.grace_window_s = grace
+    cfg.watchdog_timeout_s = watchdog
+    ff = FFModel(cfg)
+    t = ff.create_tensor((64, 16))
+    h = ff.dense(t, 32, activation=ActiMode.AC_MODE_RELU, name="h1")
+    out = ff.dense(h, 4, name="out")
+    ff.softmax(out)
+    ff.compile(AdamOptimizer(alpha=0.01))
+    return ff
+
+
+def blobs(n=256, d=16, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d) * 3
+    y = rs.randint(0, classes, n)
+    x = (centers[y] + rs.randn(n, d)).astype(np.float32)
+    return x, y.astype(np.int32).reshape(-1, 1)
+
+
+def set_fault(monkeypatch, spec):
+    """Point FFS_FAULT at ``spec`` with a FRESH plan: the parse cache
+    memoizes per spec string, and a plan's one-shot/budgeted state
+    (sigterm fired, io_error budget) must not leak between tests."""
+    faults._CACHE.pop(spec, None)
+    monkeypatch.setenv(faults.ENV, spec)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# fault grammar
+
+
+class TestFaultGrammar:
+    def test_parse_new_kinds(self):
+        plan = faults._parse("sigterm:1@step:5,hang:0@step:7,"
+                             "io_error:shards:3,kill_host:2@step:9")
+        assert plan.sigterms == [(1, 5)]
+        assert plan.hangs == [(0, 7)]
+        assert plan.io_errors == [["shards", 3]]
+        assert plan.kills == [(2, 9)]
+
+    def test_io_error_path_substr_may_contain_colons(self):
+        plan = faults._parse("io_error:a:b:2")
+        assert plan.io_errors == [["a:b", 2]]
+
+    @pytest.mark.parametrize("bad", [
+        "sigterm:x@step:3",         # non-int rank
+        "sigterm:0@epoch:3",        # wrong @ keyword
+        "hang:0",                   # missing @step
+        "io_error:shards",          # missing count
+        "io_error::2",              # empty substr
+        "io_error:shards:0",        # count < 1
+        "io_error:shards:x",        # non-int count
+        "io_error:shards:2@step:1",  # io_error takes no @step
+        "resurrect:0@step:1",       # unknown kind
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError, match="cannot parse fault"):
+            faults._parse(bad)
+
+    def test_io_check_budget_spends_and_exhausts(self):
+        plan = faults._parse("io_error:shards:2")
+        with pytest.raises(OSError):
+            plan.io_check("/ckpt/step_1/shards_host0000.npz")
+        with pytest.raises(OSError):
+            plan.io_check("/ckpt/step_1/shards_host0000.npz")
+        # budget spent: the third write succeeds (transient, not fatal)
+        plan.io_check("/ckpt/step_1/shards_host0000.npz")
+        # non-matching paths never fail
+        plan2 = faults._parse("io_error:shards:1")
+        plan2.io_check("/ckpt/step_1/MANIFEST.json")
+        assert plan2.io_errors == [["shards", 1]]
+
+
+# ---------------------------------------------------------------------------
+# watchdog (fake clock — no real multi-second sleeps)
+
+
+class TestWatchdog:
+    def test_unarmed_until_first_beat(self):
+        """Startup (checkpoint restore, first-step JIT compile) emits
+        no heartbeat and must never be reaped as a hang: the watchdog
+        only arms once the first beat lands."""
+        clk = FakeClock()
+        trips = []
+        w = Watchdog(10.0, clock=clk, on_trip=lambda: trips.append(1))
+        clk.advance(1000.0)  # arbitrarily long silent startup
+        assert not w.check() and w.seconds_since_beat() == 0.0
+        w.beat("step 0")
+        clk.advance(10.5)
+        assert w.check() and trips == [1]
+
+    def test_no_trip_within_timeout_and_beat_resets(self):
+        clk = FakeClock()
+        trips = []
+        w = Watchdog(10.0, clock=clk, on_trip=lambda: trips.append(1))
+        w.beat("step 0")
+        clk.advance(9.0)
+        assert not w.check()
+        w.beat("step 3")
+        clk.advance(9.0)
+        assert not w.check() and not trips
+
+    def test_trip_fires_once_counter_and_stacks(self, capsys):
+        clk = FakeClock()
+        trips = []
+        reg = get_registry()
+        before = reg.get("t1wd/watchdog_trip")
+        w = Watchdog(10.0, run_name="t1wd", clock=clk,
+                     on_trip=lambda: trips.append(1))
+        w.beat("step 4")
+        clk.advance(10.5)
+        assert w.check() and w.tripped
+        assert w.check()  # latched: the trip action never double-fires
+        assert trips == [1]
+        assert reg.get("t1wd/watchdog_trip") - before == 1
+        err = capsys.readouterr().err
+        assert "no progress for" in err and "step 4" in err
+        assert "thread" in err  # the stack dump
+
+    def test_default_trip_finalizes_then_exits_hung(self):
+        clk = FakeClock()
+        order = []
+        w = Watchdog(5.0, clock=clk,
+                     finalize_fn=lambda: order.append("finalize"),
+                     exit_fn=lambda code: order.append(code))
+        w.beat()
+        clk.advance(6.0)
+        assert w.check()
+        assert order == ["finalize", HUNG_EXIT]
+
+    def test_finalize_error_still_exits(self):
+        clk = FakeClock()
+        codes = []
+
+        def boom():
+            raise RuntimeError("trace dir gone")
+
+        w = Watchdog(5.0, clock=clk, finalize_fn=boom,
+                     exit_fn=codes.append)
+        w.beat()
+        clk.advance(6.0)
+        assert w.check() and codes == [HUNG_EXIT]
+
+    def test_polling_thread_starts_and_stops(self):
+        import threading
+        tripped = threading.Event()
+        w = Watchdog(0.15, on_trip=tripped.set, poll_interval_s=0.03)
+        w.beat()  # arm: the thread only times armed watchdogs
+        w.start()
+        assert tripped.wait(timeout=3.0)
+        w.stop()
+
+    def test_dump_thread_stacks_lists_main(self):
+        buf = io.StringIO()
+        dump_thread_stacks(buf)
+        assert "MainThread" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# preemption handler
+
+
+class TestPreemptionHandler:
+    def test_request_sets_flag_and_counter(self):
+        reg = get_registry()
+        before = reg.get("t1pre/preemption_signal")
+        h = PreemptionHandler(grace_window_s=0.0, run_name="t1pre")
+        assert not h.should_stop()
+        h.request_preempt("test")
+        assert h.should_stop() and h.reason == "test"
+        assert reg.get("t1pre/preemption_signal") - before == 1
+        h.request_preempt("again")  # idempotent: no double count
+        assert reg.get("t1pre/preemption_signal") - before == 1
+
+    def test_maintenance_notice_polled_and_time_gated(self):
+        clk = FakeClock()
+        polls = []
+
+        def notice():
+            polls.append(clk.t)
+            return len(polls) >= 2
+
+        h = PreemptionHandler(grace_window_s=0.0, notice_fn=notice,
+                              notice_poll_s=5.0, clock=clk)
+        assert not h.should_stop() and polls == [0.0]
+        clk.advance(1.0)
+        assert not h.should_stop() and polls == [0.0]  # gated
+        clk.advance(5.0)
+        assert h.should_stop() and polls == [0.0, 6.0]
+        assert h.reason == "maintenance_notice"
+
+    def test_second_signal_exits_immediately(self):
+        codes = []
+        h = PreemptionHandler(grace_window_s=0.0, exit_fn=codes.append)
+        h._on_signal(15, None)
+        assert h.preempted and not codes
+        h._on_signal(15, None)
+        assert codes == [PREEMPTED_EXIT]
+
+    def test_grace_deadline_enforced_and_cancellable(self):
+        import threading
+        fired = threading.Event()
+        h = PreemptionHandler(grace_window_s=0.2,
+                              exit_fn=lambda c: fired.set())
+        h.request_preempt("test")
+        assert fired.wait(timeout=3.0)  # overrun -> hard exit
+        cancelled = threading.Event()
+        h2 = PreemptionHandler(grace_window_s=0.3,
+                               exit_fn=lambda c: cancelled.set())
+        h2.request_preempt("test")
+        h2.uninstall()  # graceful path finished first
+        assert not cancelled.wait(timeout=0.6)
+
+    def test_runtime_health_step_done_raises_preempted(self):
+        health = RuntimeHealth(grace_window_s=0.0, watchdog_timeout_s=0.0,
+                               notice_fn=lambda: True,
+                               exit_fn=lambda c: None)
+        try:
+            # the very first poll sees the notice — Preempted surfaces
+            # at the next step boundary, after the in-flight step
+            with pytest.raises(Preempted) as ei:
+                health.step_done(0)
+            assert ei.value.code == PREEMPTED_EXIT
+        finally:
+            health.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+
+
+class TestSupervisor:
+    def test_exit_code_classification_table(self):
+        assert classify_exit(0) == "clean"
+        assert classify_exit(KILL_EXIT) == "kill"
+        assert classify_exit(PREEMPTED_EXIT) == "preempted"
+        assert classify_exit(HUNG_EXIT) == "hung"
+        assert classify_exit(1) == "crash"       # python traceback
+        assert classify_exit(137) == "crash"     # shell's SIGKILL
+        assert classify_exit(-9) == "crash"      # subprocess signal code
+        assert classify_exit(None) == "crash"
+
+    def test_restart_loop_resume_flag_fault_clear_backoff(self, tmp_path):
+        codes = [HUNG_EXIT, PREEMPTED_EXIT, 0]
+        calls = []
+
+        def run(cmd, env):
+            calls.append((list(cmd), dict(env)))
+            return codes[len(calls) - 1]
+
+        slept = []
+        state = str(tmp_path / "SUPERVISOR.json")
+        sup = Supervisor(["train", "--checkpoint-dir", "d"],
+                         max_restarts=3, backoff_base_s=1.0,
+                         backoff_max_s=3.0, state_path=state,
+                         env={"FFS_FAULT": "hang:0@step:3", "KEEP": "1"},
+                         run_fn=run, sleep_fn=slept.append,
+                         clock=FakeClock())
+        s = sup.run()
+        assert s["final_outcome"] == "clean" and s["restarts"] == 2
+        assert [h["outcome"] for h in s["history"]] == \
+            ["hung", "preempted", "clean"]
+        # attempt 0 keeps the injected fault; restarts clear it and
+        # append --resume exactly once
+        assert calls[0][0] == ["train", "--checkpoint-dir", "d"]
+        assert "FFS_FAULT" in calls[0][1]
+        for cmd, env in calls[1:]:
+            assert cmd[-1] == "--resume" and cmd.count("--resume") == 1
+            assert "FFS_FAULT" not in env and env["KEEP"] == "1"
+        # bounded exponential backoff: 1, 2 (cap 3 untouched)
+        assert slept == [1.0, 2.0]
+        # state record is the goodput fold's input
+        rec = mf.read_json(state)
+        assert rec["restarts"] == 2 and rec["final_outcome"] == "clean"
+        assert rec["outcomes"] == {"hung": 1, "preempted": 1, "clean": 1}
+
+    def test_budget_exhaustion_returns_last_code(self):
+        sup = Supervisor(["train"], max_restarts=2, backoff_base_s=10.0,
+                         backoff_max_s=15.0, env={},
+                         run_fn=lambda cmd, env: KILL_EXIT,
+                         sleep_fn=lambda s: None, clock=FakeClock())
+        s = sup.run()
+        assert s["attempts"] == 3 and s["final_outcome"] == "kill"
+        assert s["final_code"] == KILL_EXIT
+        # backoff cap engaged on the second restart
+        assert sup.backoff_s(0) == 10.0 and sup.backoff_s(1) == 15.0
+
+    def test_goodput_folds_supervisor_downtime(self, tmp_path):
+        """A run living under supervise.py pays the restart backoff in
+        goodput_effective: finalize reads SUPERVISOR.json's downtime_s
+        into the denominator and gauges the restart count."""
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        cdir = str(tmp_path)
+        mf.atomic_write_json(os.path.join(cdir, mf.SUPERVISOR_NAME),
+                             dict(restarts=2, downtime_s=40.0))
+        mgr = CheckpointManager(ff, cdir, every=0, run_name="supgp")
+        mgr.finalize(elapsed_s=10.0, steps=4)
+        g = get_registry().to_dict()["gauges"]
+        assert g["supgp/supervisor_restarts"] == 2.0
+        assert g["supgp/supervisor_downtime_s"] == 40.0
+        # productive <= 10 against a 50s denominator: goodput <= ~0.2
+        assert g["supgp/goodput_effective"] <= 10.0 / 50.0 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# the in-process grace path through a real fit
+
+
+class TestGracefulPreemptionFit:
+    def test_sigterm_cuts_grace_checkpoint_and_resume_is_bitwise(
+            self, tmp_path, monkeypatch):
+        """The acceptance arc, in one process: FFS_FAULT sigterm fires
+        mid-epoch, fit finishes the in-flight step, cuts a final
+        checkpoint through the CheckpointManager, and exits with
+        PREEMPTED_EXIT; the resumed run continues bit-identically to
+        an uninterrupted one."""
+        x, y = blobs()
+        cdir = str(tmp_path / "ck")
+        set_fault(monkeypatch, "sigterm:0@step:2")
+        ff = small_model(checkpoint_dir=cdir, grace=60.0)
+        with pytest.raises(SystemExit) as ei:
+            ff.fit(x, y, epochs=2, verbose=False)
+        assert ei.value.code == PREEMPTED_EXIT
+        monkeypatch.delenv(faults.ENV)
+        # the grace checkpoint is the post-in-flight-step state
+        step, sdir = latest_complete(cdir)
+        assert step == 3
+        rep = verify_step_dir(sdir)
+        assert rep["complete"], rep["errors"]
+        reg = get_registry().to_dict()
+        assert reg["counters"]["fit/preemption_signal"] >= 1
+        assert reg["gauges"]["fit/grace_checkpoint_s"] > 0
+        # signal handlers restored (fit's finally ran): whatever owns
+        # SIGTERM now, it is not our preemption handler
+        import signal
+        h = signal.getsignal(signal.SIGTERM)
+        owner = getattr(h, "__self__", None)
+        assert not isinstance(owner, PreemptionHandler)
+        # auto-resume: same command line, bit-identical end state
+        ff2 = small_model(checkpoint_dir=cdir)
+        ff2.fit(x, y, epochs=2, verbose=False, resume=True)
+        ff3 = small_model()
+        ff3.fit(x, y, epochs=2, verbose=False)
+        assert ff2._last_loss == ff3._last_loss
+
+
+# ---------------------------------------------------------------------------
+# io_error: transient absorbed, exhausted surfaces at next save
+
+
+class TestIoErrorRetry:
+    def test_transient_io_error_retried_save_completes(self, tmp_path,
+                                                       monkeypatch):
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        monkeypatch.setenv("FFS_CKPT_IO_BACKOFF_S", "0.01")
+        set_fault(monkeypatch, "io_error:shards_host:2")
+        reg = get_registry()
+        before = reg.get("ckpt/io_retries")
+        save_sharded(str(tmp_path), ff)
+        # acceptance: the retry count is visible in obs counters
+        assert reg.get("ckpt/io_retries") - before == 2
+        step, sdir = latest_complete(str(tmp_path))
+        assert verify_step_dir(sdir)["complete"]
+
+    def test_exhausted_writer_error_surfaces_at_next_save_chained(
+            self, tmp_path, monkeypatch):
+        """Satellite regression: a writer that dies from a RETRY-
+        EXHAUSTED I/O error must surface at the next save() with the
+        underlying OSError chained — not silently later at
+        finalize()."""
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        monkeypatch.setenv("FFS_CKPT_IO_BACKOFF_S", "0.005")
+        set_fault(monkeypatch, "io_error:shards_host:99")
+        mgr = CheckpointManager(ff, str(tmp_path), every=1,
+                                async_write=True, run_name="ioex")
+        mgr.save(ff._iter)  # async writer exhausts its retries and dies
+        with pytest.raises(RuntimeError,
+                           match="asynchronous checkpoint write") as ei:
+            mgr.save(ff._iter + 1)
+        assert isinstance(ei.value.__cause__, OSError)
+        assert "FFS_FAULT injected" in str(ei.value.__cause__)
+        # the error was consumed AT save — finalize must not re-raise a
+        # stale copy (and must not claim a durable checkpoint exists)
+        monkeypatch.delenv(faults.ENV)
+        mgr.finalize(elapsed_s=1.0, steps=2)
+
+    def test_sync_mode_raises_inline_with_cause(self, tmp_path,
+                                                monkeypatch):
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        monkeypatch.setenv("FFS_CKPT_IO_BACKOFF_S", "0.005")
+        set_fault(monkeypatch, "io_error:index_host:99")
+        mgr = CheckpointManager(ff, str(tmp_path), every=1,
+                                async_write=False, run_name="iosync")
+        with pytest.raises(RuntimeError) as ei:
+            mgr.save(ff._iter)
+        assert isinstance(ei.value.__cause__, OSError)
+
+
+# ---------------------------------------------------------------------------
+# rank-local restore
+
+
+class TestRankLocalRestore:
+    def _rows(self, n_hosts, rows_per_host, cols, bytes_per_row):
+        """A synthetic saved shard index: one leaf of
+        [n_hosts*rows_per_host, cols], split row-wise across hosts."""
+        entries = []
+        for h in range(n_hosts):
+            lo = h * rows_per_host
+            entries.append((f"shards_host{h:04d}.npz",
+                            dict(key=f"k::{h}",
+                                 index=[[lo, lo + rows_per_host],
+                                        [0, cols]],
+                                 crc32=0,
+                                 bytes=bytes_per_row * rows_per_host)))
+        return entries
+
+    def test_same_mesh_reads_one_host_share(self):
+        from flexflow_tpu.ckpt.sharded import _select_rows
+        entries = self._rows(n_hosts=4, rows_per_host=16, cols=8,
+                             bytes_per_row=32)
+        # this host's live boxes = host 1's slice exactly
+        needed = [[[16, 32], [0, 8]]]
+        sel, skip, want, local = _select_rows(entries, needed)
+        assert local
+        assert [e[1]["key"] for e in sel] == ["k::1"]
+        assert want == 16 * 8
+        # the byte-count assertion: 1/host_count read, the rest skipped
+        sel_bytes = sum(e[1]["bytes"] for e in sel)
+        skip_bytes = sum(e[1]["bytes"] for e in skip)
+        assert sel_bytes == 32 * 16
+        assert skip_bytes == 3 * 32 * 16
+
+    def test_replicated_leaf_full_box_matches(self):
+        from flexflow_tpu.ckpt.sharded import _select_rows
+        entries = [("shards_host0000.npz",
+                    dict(key="k::0", index=[[0, 64], [0, 8]], crc32=0,
+                         bytes=2048))]
+        sel, skip, want, local = _select_rows(entries,
+                                              [[[0, 64], [0, 8]]])
+        assert local and len(sel) == 1 and not skip and want == 64 * 8
+
+    def test_changed_boxes_fall_back_to_full_scan(self):
+        from flexflow_tpu.ckpt.sharded import _select_rows
+        entries = self._rows(n_hosts=4, rows_per_host=16, cols=8,
+                             bytes_per_row=32)
+        # live box [0,32) straddles two saved boxes: partial overlap
+        sel, skip, want, local = _select_rows(entries,
+                                              [[[0, 32], [0, 8]]])
+        assert not local and want is None
+        assert sel == entries and skip == []
+
+    def test_unknowable_leaf_full_scan(self):
+        from flexflow_tpu.ckpt.sharded import _select_rows
+        entries = self._rows(2, 16, 8, 32)
+        sel, skip, want, local = _select_rows(entries, None)
+        assert not local and sel == entries
+
+    def test_single_process_reads_all_and_counter_tracks(self, tmp_path):
+        """Single-process: every box is addressable, so rank-local mode
+        selects everything — the read-bytes counter must equal the
+        checkpoint's payload and the restore stays bitwise."""
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        save_sharded(str(tmp_path), ff)
+        _, sdir = latest_complete(str(tmp_path))
+        payload = verify_step_dir(sdir, deep=False)["payload_bytes"]
+        reg = get_registry()
+        before_read = reg.get("ckpt/restore_read_bytes")
+        before_skip = reg.get("ckpt/restore_skipped_bytes")
+        ff2 = small_model()
+        assert load_sharded(str(tmp_path), ff2) == ff._iter
+        assert reg.get("ckpt/restore_read_bytes") - before_read == payload
+        assert reg.get("ckpt/restore_skipped_bytes") - before_skip == 0
+        np.testing.assert_array_equal(
+            np.asarray(ff.params["h1"]["kernel"]),
+            np.asarray(ff2.params["h1"]["kernel"]))
+
+
+# ---------------------------------------------------------------------------
+# dataloader cursor: seek on resume, no redundant fetches
+
+
+class TestLoaderCursor:
+    def test_seek_bounds(self):
+        from flexflow_tpu.dataloader import create_data_loaders
+        x, y = blobs()
+        ff = small_model()
+        loaders = create_data_loaders(ff, x, y)
+        with pytest.raises(ValueError, match="seek"):
+            loaders.input_loaders[0].seek(loaders.num_batches)
+        loaders.seek(2)
+        assert loaders.input_loaders[0].next_index == 2 * 64
+
+    def test_resume_seeks_no_redundant_fetches_and_bitwise(self,
+                                                           tmp_path):
+        """Satellite acceptance: the resumed fit_loader run seeks to
+        the recorded cursor instead of fetch-and-discarding covered
+        batches (fetch count == executed steps), the manifest carries
+        the epoch/batch cursor, and the end state is bit-identical to
+        an uninterrupted run."""
+        from flexflow_tpu.dataloader import create_data_loaders
+        x, y = blobs()
+        cdir = str(tmp_path)
+
+        # uninterrupted reference
+        ff_ref = small_model()
+        ff_ref.fit_loader(create_data_loaders(ff_ref, x, y), epochs=2,
+                          verbose=False)
+
+        # interrupted: 1 epoch (4 steps) with a final checkpoint
+        ff1 = small_model(checkpoint_dir=cdir)
+        ff1.fit_loader(create_data_loaders(ff1, x, y), epochs=1,
+                       verbose=False)
+        manifest = load_manifest(cdir)
+        cur = manifest["client_state"]["loader"]
+        assert cur == dict(iteration=4, epoch=1, batch=0, num_batches=4)
+
+        # resumed to the total schedule, counting real fetches
+        ff2 = small_model(checkpoint_dir=cdir)
+        loaders = create_data_loaders(ff2, x, y)
+        fetches = []
+        orig = loaders.next_batch
+        loaders.next_batch = lambda: (fetches.append(1), orig())[1]
+        ff2.fit_loader(loaders, epochs=2, verbose=False, resume=True)
+        assert len(fetches) == 4  # only the uncovered step slots
+        assert ff2._last_loss == ff_ref._last_loss
+
+    def test_mid_epoch_resume_seeks_to_batch(self, tmp_path):
+        """A checkpoint cadence that stops mid-epoch: the resumed run
+        must seek to the intra-epoch batch, not epoch start."""
+        from flexflow_tpu.dataloader import create_data_loaders
+        x, y = blobs()
+        cdir = str(tmp_path)
+        ff_ref = small_model()
+        ff_ref.fit_loader(create_data_loaders(ff_ref, x, y), epochs=2,
+                          verbose=False)
+
+        ff1 = small_model(checkpoint_dir=cdir)
+        loaders1 = create_data_loaders(ff1, x, y)
+        mgr = CheckpointManager(ff1, cdir, every=0, run_name="midres")
+        # train 6 of 8 slots by hand through fit_loader's own loop:
+        # epochs=2 but kill via a 6-step cadence is simpler to emulate
+        # with a direct fit of epochs=1 + 2 manual steps; instead run
+        # the supported path: full first epoch + checkpoint, then
+        # resume lands at epoch 1 batch 0 — the mid-epoch variant:
+        ff1.fit_loader(loaders1, epochs=1, verbose=False)
+        # advance 2 more steps manually (epoch 1, batches 0-1)
+        loaders1.reset()
+        train_step = ff1.executor.make_train_step()
+        import jax
+        for _ in range(2):
+            inputs, labels = loaders1.next_batch()
+            ff1._rng, sub = jax.random.split(ff1._rng)
+            (ff1.params, ff1.opt_state, ff1.state, loss,
+             _) = train_step(ff1.params, ff1.opt_state, ff1.state,
+                             inputs, labels, sub)
+            ff1._iter += 1
+        mgr.save(ff1._iter)
+        mgr.wait()
+        # manager-level saves carry no loader cursor (fit_loader owns
+        # it) — the iteration-derived seek must still line up
+        assert "client_state" not in load_manifest(cdir)
+
+        ff2 = small_model(checkpoint_dir=cdir)
+        loaders2 = create_data_loaders(ff2, x, y)
+        fetches = []
+        orig = loaders2.next_batch
+        loaders2.next_batch = lambda: (fetches.append(1), orig())[1]
+        ff2.fit_loader(loaders2, epochs=2, verbose=False, resume=True)
+        assert len(fetches) == 2  # slots 6,7 only
+        assert ff2._last_loss == ff_ref._last_loss
