@@ -28,6 +28,18 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def ensure_virtual_host_devices(n: int = 8) -> None:
+    """Give the CPU backend ``n`` virtual host devices BEFORE jax
+    initializes (harmless on TPU — the flag only affects the host
+    platform). The ONE bootstrap shared by bench main/serve and
+    scripts/serve_bench.py; call before the first ``import jax``."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+
+
 def single_device_mesh_on_cpu(on_cpu):
     """Explicit 1-device mesh for the legacy workload families on CPU:
     main() forces 8 virtual host devices so the pipeline workload has a
@@ -500,14 +512,109 @@ def hbm_ratchet(hist, key, peak_bytes, tol=0.02):
     return _low_water_ratchet(hist, key, "hbm_peak_bytes", peak_bytes, tol)
 
 
+def latency_ratchet(hist, key, field, value_s, tol=0.5, max_drop=0.5):
+    """Downward ratchet on a measured request-latency percentile
+    (BENCH_NOTES r14): lower is better; generous relative tolerance
+    because closed-loop CPU/tunnel latency is far noisier than the
+    compile-determined ratchets, and one outlier-fast round may tighten
+    the baseline by at most half. FFS_SKIP_LATENCY=1 opts out (the
+    low-water value still records)."""
+    return _low_water_ratchet(
+        hist, key, field, value_s, tol, abs_tol=0.001,
+        skip=bool(os.environ.get("FFS_SKIP_LATENCY")), max_drop=max_drop)
+
+
+def serve_main(argv):
+    """`bench.py serve`: closed-loop inference-serving latency bench —
+    the latency sibling of the training-throughput families. Drives the
+    flexflow_tpu/serve engine (continuous batching + latency-searched
+    bucket executors) with the BENCH_NOTES r14 protocol (per-bucket
+    warmup excluded, closed-loop clients) and ratchets p50/p99 request
+    latency downward in the same bench_history.json the throughput
+    ratchets live in. Prints ONE JSON line."""
+    ensure_virtual_host_devices()
+    import jax
+
+    sys.path.insert(0, REPO)
+    on_cpu = jax.devices()[0].platform == "cpu"
+    platform = "cpu" if on_cpu else "tpu"
+    hist_path, hist = load_history()
+    models = [a for a in argv if not a.startswith("-")] or ["transformer"]
+    trace_dir = os.environ.get("FFS_TRACE_DIR") or None
+
+    from flexflow_tpu.serve.loadgen import (build_serve_model,
+                                            run_serve_workload)
+
+    result = {"metric": "serve_request_latency", "unit": "s",
+              "workloads": {}}
+    regressions = []
+    for name in models:
+        try:
+            # fresh registry per workload: the serve/* series (latency
+            # reservoir, occupancy) are process-global — without a reset
+            # the second model's report would blend in the first's
+            from flexflow_tpu.obs.registry import get_registry
+            get_registry().reset()
+            ff, make_request, cfg_dict = build_serve_model(name, on_cpu)
+            report = run_serve_workload(
+                ff, make_request,
+                num_requests=(24 if on_cpu else 200),
+                concurrency=4, search_budget=4, trace_dir=trace_dir)
+        except Exception as e:
+            result["workloads"][name] = {
+                "error": f"{type(e).__name__}: {e}"}
+            continue
+        loop = report["closed_loop"]
+        key = f"serve_{name}:{platform}"
+        wl = dict(
+            p50_s=round(loop.get("p50_s", 0.0), 6),
+            p99_s=round(loop.get("p99_s", 0.0), 6),
+            throughput_rps=round(loop.get("throughput_rps", 0.0), 2),
+            num_measured=loop.get("num_measured"),
+            buckets={b: dict(objective=e["objective"],
+                             differs=e["strategy_differs_from_training"])
+                     for b, e in report["buckets"].items()},
+        )
+        occ = report.get("registry", {}).get("occupancy_mean")
+        if occ is not None:
+            wl["occupancy_mean"] = round(occ, 4)
+        fields = ("request_latency_p50_s", "request_latency_p99_s")
+        prev = dict(hist.get(key) or {}) if isinstance(hist.get(key),
+                                                       dict) else {}
+        for field, v in zip(fields, (loop.get("p50_s"),
+                                     loop.get("p99_s"))):
+            if v is None:
+                continue
+            reg, base = latency_ratchet(hist, key, field, v)
+            if reg:
+                regressions.append(
+                    f"{name}: {field} {v:.6f}s vs recorded best "
+                    f"{base:.6f}s")
+        ent = hist.get(key)
+        if isinstance(ent, dict):
+            # provenance follows the RECORDED BEST, not the latest run
+            # (the ratchet() discipline): protocol/config update only
+            # when this run actually lowered a baseline
+            improved = any(ent.get(f) != prev.get(f) for f in fields)
+            if improved or "protocol" not in ent:
+                ent.update(
+                    protocol="closed4x" + str(loop.get("num_measured")),
+                    config=cfg_dict,
+                    throughput_rps=wl["throughput_rps"])
+        result["workloads"][name] = wl
+        del ff
+    try:
+        save_history(hist_path, hist)
+    except Exception:
+        pass
+    if regressions:
+        result["latency_regressions"] = regressions
+    print(json.dumps(result))
+
+
 def main():
-    # the pipeline workload needs a pipe x data mesh: give the CPU
-    # backend virtual host devices BEFORE jax initializes (harmless on
-    # TPU — the flag only affects the host platform)
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
+    # the pipeline workload needs a pipe x data mesh
+    ensure_virtual_host_devices()
     import jax
 
     sys.path.insert(0, REPO)
@@ -739,4 +846,7 @@ def searched_vs_dp_ratio(on_cpu):
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        serve_main(sys.argv[2:])
+    else:
+        main()

@@ -201,25 +201,34 @@ def verify_step_dir(step_dir: str, deep: bool = True) -> Dict[str, Any]:
                 if npz is None:
                     continue
                 key = sh["key"]
-                if key not in npz.files:
-                    errors.append(f"shard '{key}' listed in {idx_file} "
-                                  f"absent from {index['shards_file']}")
+                # chunked shards (flexflow_tpu/ckpt/sharded.py chunk
+                # threshold) store only their chunk entries in the npz;
+                # the base key is the row's logical name
+                pieces = sh.get("chunks") or [sh]
+                missing = [p["key"] for p in pieces
+                           if p["key"] not in npz.files]
+                if missing:
+                    errors.append(
+                        f"shard '{key}' pieces {missing} listed in "
+                        f"{idx_file} absent from {index['shards_file']}")
                     continue
                 if deep:
+                    # the shared per-piece CRC check (sharded._crc_check
+                    # via verify_shard_row) — same "intact" definition
+                    # as restore, but piece-by-piece with NO reassembly:
+                    # verifying a multi-GB chunked shard needs O(chunk)
+                    # memory, not 2x the shard. Lazy import: sharded
+                    # imports this module at top level.
+                    from flexflow_tpu.ckpt.sharded import verify_shard_row
                     try:
-                        data = np.ascontiguousarray(npz[key])
-                    except Exception as e:  # zip-level CRC / truncation
+                        verify_shard_row(npz, sh)
+                    except ValueError as e:  # stored-CRC mismatch
                         errors.append(
-                            f"shard '{key}' of '{leaf_key}' is unreadable "
-                            f"({e}) — on-disk corruption")
-                        continue
-                    crc = crc32_bytes(data.tobytes())
-                    if crc != int(sh["crc32"]):
+                            f"{e} on '{leaf_key}' — on-disk corruption")
+                    except Exception as e:  # zip CRC / truncation
                         errors.append(
-                            f"checksum mismatch for shard '{key}' of "
-                            f"'{leaf_key}' (stored {sh['crc32']:#010x}, "
-                            f"recomputed {crc:#010x}) — on-disk "
-                            f"corruption")
+                            f"shard '{key}' of '{leaf_key}' is "
+                            f"unreadable ({e}) — on-disk corruption")
     for leaf_key, meta in leaves.items():
         want = int(np.prod(meta["shape"])) if meta["shape"] else 1
         if covered.get(leaf_key, 0) != want:

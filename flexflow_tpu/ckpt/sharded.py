@@ -81,6 +81,66 @@ def _retry_io(what: str, fn, heartbeat=None):
                 heartbeat(f"ckpt io retry {attempt}")
 
 
+#: shard payloads above this split into CRC'd chunks at write
+#: (``FFS_CKPT_CHUNK_BYTES`` overrides; 0 disables chunking)
+DEFAULT_CHUNK_BYTES = 128 << 20
+
+
+def chunk_threshold_bytes() -> int:
+    try:
+        return int(os.environ.get("FFS_CKPT_CHUNK_BYTES",
+                                  DEFAULT_CHUNK_BYTES))
+    except ValueError:
+        return DEFAULT_CHUNK_BYTES
+
+
+def _crc_check(piece: Dict[str, Any], data: np.ndarray,
+               what: str) -> None:
+    """The ONE per-piece CRC32 check (whole shards and chunks alike) —
+    load and verify can never disagree on what "intact" means."""
+    crc = mf.crc32_bytes(data.tobytes())
+    if crc != int(piece["crc32"]):
+        raise ValueError(
+            f"checksum mismatch on {what} '{piece['key']}' (stored "
+            f"{int(piece['crc32']):#010x}, recomputed {crc:#010x})")
+
+
+def verify_shard_row(npz, row: Dict[str, Any]) -> None:
+    """CRC-verify one index row piece by piece WITHOUT reassembling —
+    O(chunk) memory, the point of chunking on the verify path
+    (``manifest.verify_step_dir``). Raises ValueError on corruption."""
+    chunks = row.get("chunks")
+    if not chunks:
+        _crc_check(row, np.ascontiguousarray(npz[row["key"]]), "shard")
+        return
+    for ch in chunks:
+        _crc_check(ch, np.ascontiguousarray(npz[ch["key"]]), "chunk")
+
+
+def read_shard_row(npz, row: Dict[str, Any],
+                   verify: bool = True) -> np.ndarray:
+    """Read one index row's payload from an open npz — whole-shard or
+    chunked — verifying CRC32s when ``verify``. Chunked rows reassemble
+    by concatenating the 1-D chunk payloads and reshaping to the row's
+    box shape; each read is capped at chunk size (the serving loader's
+    per-request read bound). Raises ValueError on corruption."""
+    chunks = row.get("chunks")
+    if not chunks:
+        data = np.ascontiguousarray(npz[row["key"]])
+        if verify:
+            _crc_check(row, data, "shard")
+        return data
+    parts = []
+    for ch in chunks:
+        part = np.ascontiguousarray(npz[ch["key"]])
+        if verify:
+            _crc_check(ch, part, "chunk")
+        parts.append(part.reshape(-1))
+    data = np.concatenate(parts)
+    return data.reshape([max(0, b[1] - b[0])
+                         for b in row.get("index", [])])
+
+
 def _np_dtype(name: str) -> np.dtype:
     try:
         return np.dtype(name)
@@ -207,7 +267,9 @@ def snapshot(ffmodel, step: Optional[int] = None,
         mesh=mesh_axes,
         num_devices=int(np.prod(ffmodel.mesh.devices.shape)),
         strategy=strategy_json(mesh_axes, ffmodel.strategy or {},
-                               ffmodel.executor.nodes),
+                               ffmodel.executor.nodes,
+                               objective=getattr(ffmodel,
+                                                 "search_objective", None)),
         wall_unix=time.time(),
     )
     if client_state is not None:
@@ -229,6 +291,7 @@ def write_snapshot(directory: str, snap: ShardSnapshot,
     step_dir = os.path.join(directory, mf.step_dir_name(snap.step))
     os.makedirs(step_dir, exist_ok=True)
     plan = faults.get_plan()
+    chunk_bytes = chunk_threshold_bytes()
 
     arrays: Dict[str, np.ndarray] = {}
     index: Dict[str, List[Dict[str, Any]]] = {}
@@ -242,14 +305,46 @@ def write_snapshot(directory: str, snap: ShardSnapshot,
             # the rot
             payload = arr.tobytes()
             crc = mf.crc32_bytes(payload)
+            # shard files above the chunk threshold split into CRC'd
+            # chunks (ROADMAP elastic follow-on (b)): bounded write
+            # units, and the serving loader's per-request reads are
+            # capped at chunk size instead of whole-shard size. Chunk
+            # CRCs are computed from the CLEAN payload, before the
+            # corrupt_shard seam, so injected rot is caught per chunk.
+            # the ONE slicing: (key, start, stop) per chunk, shared by
+            # the clean-payload CRC pass and the (possibly corrupted)
+            # storage pass below — they can never desynchronize
+            slices = None
+            if chunk_bytes and arr.nbytes > chunk_bytes and arr.size > 1:
+                epc = max(1, chunk_bytes // max(1, arr.dtype.itemsize))
+                slices = [(f"{npz_key}::c{j}", off,
+                           min(off + epc, arr.size))
+                          for j, off in enumerate(
+                              range(0, arr.size, epc))]
+            chunk_meta = None
+            if slices is not None:
+                flat = arr.reshape(-1)
+                chunk_meta = [dict(
+                    key=ck,
+                    crc32=int(mf.crc32_bytes(flat[o:e].tobytes())),
+                    bytes=int(flat[o:e].nbytes)) for ck, o, e in slices]
             if plan is not None:
                 hurt = plan.corrupt_bytes(leaf_key, snap.step, payload)
                 if hurt is not payload:
                     arr = np.frombuffer(hurt, dtype=arr.dtype).reshape(
                         arr.shape)
-            arrays[npz_key] = arr
-            rows.append(dict(key=npz_key, index=box, crc32=int(crc),
-                             bytes=int(arr.nbytes)))
+            row = dict(key=npz_key, index=box, crc32=int(crc),
+                       bytes=int(arr.nbytes))
+            if slices is not None:
+                flat = arr.reshape(-1)
+                for ck, o, e in slices:
+                    arrays[ck] = flat[o:e]
+                row["chunks"] = chunk_meta
+                from flexflow_tpu.obs.registry import get_registry
+                get_registry().inc("ckpt/chunked_shards")
+            else:
+                arrays[npz_key] = arr
+            rows.append(row)
         index[leaf_key] = rows
 
     shards_file = mf.shards_name(snap.process_index)
@@ -414,7 +509,8 @@ def _gather_agree(value: int, what: str) -> int:
 
 
 def load_sharded(path: str, ffmodel, verify: bool = True,
-                 rank_local: bool = True) -> int:
+                 rank_local: bool = True,
+                 include_opt_state: bool = True) -> int:
     """Restore a v2 per-shard checkpoint onto the live model.
 
     ``path`` is a checkpoint root (newest complete step is taken) or a
@@ -425,8 +521,16 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
     reads + CRC-verifies only the shards whose boxes this host's live
     arrays actually cover, falling back per-leaf to the full scan when
     the saved boxes don't line up with the live ones (mesh changed).
+    ``include_opt_state=False`` skips the optimizer-state leaves
+    entirely — no reads, no reassembly — for forward-only consumers
+    (the serving loader restores a training checkpoint into an
+    INFERENCE-compiled model, which allocates no optimizer state).
     Returns the restored iteration counter."""
     from flexflow_tpu.obs.registry import get_registry
+
+    def _wanted(leaf_key: str) -> bool:
+        return include_opt_state or not (
+            leaf_key == "opt_state" or leaf_key.startswith("opt_state/"))
 
     step_dir = mf.resolve_step_dir(path)
     local = -1 if step_dir is None else _read_step(step_dir)
@@ -446,6 +550,8 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
     want: Dict[str, int] = {}
     local_mode: Dict[str, bool] = {}
     for leaf_key, meta in manifest["leaves"].items():
+        if not _wanted(leaf_key):
+            continue
         pending[leaf_key] = np.empty([int(d) for d in meta["shape"]],
                                      dtype=_np_dtype(meta["saved_dtype"]))
         filled[leaf_key] = 0
@@ -455,7 +561,7 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
 
     # gather every host's index rows BEFORE reading any shard bytes, so
     # the rank-local planner sees each leaf's complete saved shard set
-    rows_by_leaf: Dict[str, List] = {k: [] for k in manifest["leaves"]}
+    rows_by_leaf: Dict[str, List] = {k: [] for k in pending}
     for idx_file in manifest["index_files"]:
         index = mf.read_json(os.path.join(step_dir, idx_file))
         if index is None:
@@ -464,6 +570,8 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
                 f"{idx_file} is missing/unreadable despite a manifest — "
                 f"refusing a partial restore")
         for leaf_key, rows in index["shards"].items():
+            if not _wanted(leaf_key):
+                continue
             rows_by_leaf.setdefault(leaf_key, []).extend(
                 (index["shards_file"], row) for row in rows)
 
@@ -492,21 +600,16 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
             for leaf_key, row in rows:
                 dest = pending[leaf_key]
                 try:
-                    data = np.ascontiguousarray(npz[row["key"]])
+                    data = read_shard_row(npz, row, verify=verify)
+                except ValueError as e:  # stored-CRC mismatch
+                    raise ValueError(
+                        f"checkpoint {step_dir}: {e} on '{leaf_key}' — "
+                        f"on-disk corruption; refusing to restore") from e
                 except Exception as e:  # zip-level CRC / truncation
                     raise ValueError(
                         f"checkpoint {step_dir}: shard '{row['key']}' of "
                         f"'{leaf_key}' is unreadable ({e}) — on-disk "
                         f"corruption; refusing to restore") from e
-                if verify:
-                    crc = mf.crc32_bytes(data.tobytes())
-                    if crc != int(row["crc32"]):
-                        raise ValueError(
-                            f"checkpoint {step_dir}: checksum mismatch on "
-                            f"shard '{row['key']}' of '{leaf_key}' "
-                            f"(stored {int(row['crc32']):#010x}, recomputed "
-                            f"{crc:#010x}) — on-disk corruption; refusing "
-                            f"to restore")
                 read_bytes += int(row.get("bytes", data.nbytes))
                 box = row["index"]
                 if box:
@@ -522,6 +625,8 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
     reg.inc("ckpt/restore_read_bytes", read_bytes)
     reg.inc("ckpt/restore_skipped_bytes", skipped_bytes)
     for leaf_key, meta in manifest["leaves"].items():
+        if leaf_key not in pending:
+            continue  # opt-state leaf skipped by include_opt_state=False
         if filled[leaf_key] != want[leaf_key]:
             scope = ("this host's live shard boxes"
                      if local_mode[leaf_key] else "the global shape")
@@ -535,12 +640,23 @@ def load_sharded(path: str, ffmodel, verify: bool = True,
             pending[leaf_key] = pending[leaf_key].view(true)
         flat[leaf_key] = pending[leaf_key]
 
-    state = rebuild_tree(manifest["structure"], flat)
+    if include_opt_state:
+        state = rebuild_tree(manifest["structure"], flat)
+    else:
+        # rebuild only the forward-relevant subtrees; the optimizer
+        # leaves were never read
+        items = manifest["structure"]["items"]
+        state = {
+            "params": rebuild_tree(items["params"], flat, "params/"),
+            "op_state": rebuild_tree(items["op_state"], flat, "op_state/"),
+        }
     from flexflow_tpu.executor import COMPUTE_PARAMS_KEY
     live_op_state = {k: v for k, v in ffmodel.state.items()
                      if k != COMPUTE_PARAMS_KEY}
     ffmodel.params = place_tree(ffmodel.params, state["params"])
-    ffmodel.opt_state = place_tree(ffmodel.opt_state, state["opt_state"])
+    if include_opt_state:
+        ffmodel.opt_state = place_tree(ffmodel.opt_state,
+                                       state["opt_state"])
     ffmodel.state = place_tree(live_op_state, state["op_state"])
     ffmodel._compute_params_dirty = True
     ffmodel._refresh_compute_params()

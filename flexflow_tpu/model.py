@@ -553,6 +553,10 @@ class FFModel:
                 "--machine-model-version > 0 requires --machine-model-file")
         self.machine_spec = machine_spec or detect_machine_spec(n_dev)
         self.search_info = None
+        # search-objective provenance: "step_time" (TRAINING search),
+        # "latency" (INFERENCE search), None (no search ran) — recorded
+        # in exported strategy files and checkpoint manifests
+        self.search_objective = None
 
         import math as _math
         from flexflow_tpu.parallel.strategy import (
@@ -627,6 +631,7 @@ class FFModel:
                 mesh_axes, self.strategy, self.search_info = _unity.graph_optimize(
                     nodes, self.machine_spec, cfg, n_dev, batch=batch0,
                     measured=measured, final_ref=final_ref)
+                self.search_objective = self.search_info.get("objective")
                 self.mesh = make_mesh(_math.prod(mesh_axes.values()), mesh_axes)
                 # the substitution engine may have rewritten the graph —
                 # run the rewritten node list (strategy is keyed to it)
@@ -659,7 +664,8 @@ class FFModel:
         if cfg.export_strategy_file:
             axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
             _unity.export_strategy_file(cfg.export_strategy_file, axes_now,
-                                        self.strategy, nodes)
+                                        self.strategy, nodes,
+                                        objective=self.search_objective)
         apply_strategy(nodes, self.strategy, self.mesh)
         self.op_profile = None
         if cfg.profiling:
@@ -1380,6 +1386,32 @@ class FFModel:
         rep = acc.report()
         rep["loss"] = loss_sum / max(batches, 1)
         return rep
+
+    def serve(self, batch_buckets=None, max_wait_ms: float = 5.0,
+              search_budget: Optional[int] = None, start: bool = False,
+              verbose: bool = False):
+        """Production inference serving over this compiled model
+        (flexflow_tpu/serve): continuous/dynamic batching into per-
+        batch-bucket executors, each with its OWN latency-objective
+        searched sharding when ``search_budget`` (default: the
+        compile-time ``--budget``) is nonzero and the native search is
+        available. Returns a ``ServingEngine``; ``start=True`` also
+        spins its background serving thread —
+
+            engine = model.serve(start=True)
+            out = engine.submit(sample).wait()
+
+        p50/p99 request latency, queue depth, and batch occupancy land
+        in the obs registry under ``serve/*``; ``scripts/serve_bench.py``
+        drives the closed-loop benchmark."""
+        if self.executor is None:
+            raise ValueError("compile() the model before serve()")
+        from flexflow_tpu.serve import ServingEngine
+        engine = ServingEngine(self, batch_buckets=batch_buckets,
+                               max_wait_ms=max_wait_ms,
+                               search_budget=search_budget,
+                               verbose=verbose)
+        return engine.start() if start else engine
 
     def predict(self, x):
         fwd = self.executor.make_forward(training=False)

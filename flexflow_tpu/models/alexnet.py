@@ -9,22 +9,35 @@ from flexflow_tpu.model import FFModel
 
 
 def create_alexnet(batch_size: int = 64, num_classes: int = 10,
-                   image_size: int = 224, ff_config: FFConfig = None) -> FFModel:
+                   image_size: int = 224, batch_norm: bool = False,
+                   ff_config: FFConfig = None) -> FFModel:
+    """``batch_norm=True`` swaps the fused conv-ReLUs for conv→BN(+ReLU)
+    pairs (the modern AlexNet-BN variant) — a zoo path exercising the
+    Conv+BN fold the serving predict runs."""
     ff = FFModel(ff_config or FFConfig(batch_size=batch_size))
+
+    def conv(t, ch, k, s, p, name):
+        if batch_norm:
+            t = ff.conv2d(t, ch, k, k, s, s, p, p, name=name)
+            return ff.batch_norm(t, relu=True, name=f"{name}_bn")
+        return ff.conv2d(t, ch, k, k, s, s, p, p,
+                         activation=ActiMode.AC_MODE_RELU, name=name)
+
     t = ff.create_tensor((batch_size, 3, image_size, image_size))
-    t = ff.conv2d(t, 64, 11, 11, 4, 4, 2, 2, activation=ActiMode.AC_MODE_RELU)
+    t = conv(t, 64, 11, 4, 2, "conv1")
     t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
-    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation=ActiMode.AC_MODE_RELU)
+    t = conv(t, 192, 5, 1, 2, "conv2")
     t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
-    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
-    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
-    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.AC_MODE_RELU)
+    t = conv(t, 384, 3, 1, 1, "conv3")
+    t = conv(t, 256, 3, 1, 1, "conv4")
+    t = conv(t, 256, 3, 1, 1, "conv5")
     t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
     t = ff.flat(t)
-    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU)
+    # explicit names: checkpoint keys stay build-order-independent
+    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU, name="fc6")
     t = ff.dropout(t, 0.5)
-    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4096, activation=ActiMode.AC_MODE_RELU, name="fc7")
     t = ff.dropout(t, 0.5)
-    t = ff.dense(t, num_classes)
+    t = ff.dense(t, num_classes, name="fc8")
     t = ff.softmax(t)
     return ff

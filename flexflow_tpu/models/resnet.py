@@ -21,37 +21,57 @@ class ResNetConfig:
     image_size: int = 224
     num_classes: int = 10  # reference uses 10 (resnet.cc:112)
     stages: tuple = (3, 4, 6, 3)
+    # True = textbook ResNet (conv→BN→relu everywhere): the reference
+    # example omits BN, so this is opt-in for parity with resnet.cc —
+    # but it is the zoo's canonical Conv+BN-fold (serving predict) path
+    batch_norm: bool = False
 
 
-def _bottleneck(ff: FFModel, t, out_channels: int, stride: int, name: str):
+def _conv_bn(ff: FFModel, t, out_channels: int, kh: int, kw: int,
+             stride: int, pad: int, name: str, bn: bool, relu: bool):
+    if bn:
+        t = ff.conv2d(t, out_channels, kh, kw, stride, stride, pad, pad,
+                      name=name)
+        return ff.batch_norm(t, relu=relu, name=f"{name}_bn")
+    t = ff.conv2d(t, out_channels, kh, kw, stride, stride, pad, pad,
+                  activation=ActiMode.AC_MODE_RELU if relu
+                  else ActiMode.AC_MODE_NONE, name=name)
+    return t
+
+
+def _bottleneck(ff: FFModel, t, out_channels: int, stride: int, name: str,
+                bn: bool = False):
     inp = t
-    t = ff.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c1")
+    t = _conv_bn(ff, t, out_channels, 1, 1, 1, 0, f"{name}_c1", bn, False)
     t = ff.relu(t)
-    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1, name=f"{name}_c2")
+    t = _conv_bn(ff, t, out_channels, 3, 3, stride, 1, f"{name}_c2", bn,
+                 False)
     t = ff.relu(t)
-    t = ff.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c3")
+    t = _conv_bn(ff, t, 4 * out_channels, 1, 1, 1, 0, f"{name}_c3", bn,
+                 False)
     if stride > 1 or inp.shape[1] != 4 * out_channels:
         # projection shortcut has no activation (resnet.cc:53, AC_MODE_NONE)
-        inp = ff.conv2d(inp, 4 * out_channels, 1, 1, stride, stride, 0, 0,
-                        name=f"{name}_proj")
+        inp = _conv_bn(ff, inp, 4 * out_channels, 1, 1, stride, 0,
+                       f"{name}_proj", bn, False)
     t = ff.add(t, inp, name=f"{name}_add")
     return ff.relu(t, inplace=False)
 
 
 def create_resnet(cfg: ResNetConfig, ff_config: FFConfig = None) -> FFModel:
     ff = FFModel(ff_config or FFConfig(batch_size=cfg.batch_size))
+    bn = cfg.batch_norm
     t = ff.create_tensor((cfg.batch_size, 3, cfg.image_size, cfg.image_size),
                          name="input")
-    t = ff.conv2d(t, 64, 7, 7, 2, 2, 3, 3, name="stem")
+    t = _conv_bn(ff, t, 64, 7, 7, 2, 3, "stem", bn, bn)
     t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
     for i in range(cfg.stages[0]):
-        t = _bottleneck(ff, t, 64, 1, f"s1_b{i}")
+        t = _bottleneck(ff, t, 64, 1, f"s1_b{i}", bn)
     for i in range(cfg.stages[1]):
-        t = _bottleneck(ff, t, 128, 2 if i == 0 else 1, f"s2_b{i}")
+        t = _bottleneck(ff, t, 128, 2 if i == 0 else 1, f"s2_b{i}", bn)
     for i in range(cfg.stages[2]):
-        t = _bottleneck(ff, t, 256, 2 if i == 0 else 1, f"s3_b{i}")
+        t = _bottleneck(ff, t, 256, 2 if i == 0 else 1, f"s3_b{i}", bn)
     for i in range(cfg.stages[3]):
-        t = _bottleneck(ff, t, 512, 2 if i == 0 else 1, f"s4_b{i}")
+        t = _bottleneck(ff, t, 512, 2 if i == 0 else 1, f"s4_b{i}", bn)
     t = ff.pool2d(t, t.shape[2], t.shape[3], 1, 1, 0, 0,
                   pool_type=PoolType.POOL_AVG)
     t = ff.flat(t)

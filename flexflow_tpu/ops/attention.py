@@ -20,12 +20,16 @@ from flexflow_tpu.initializers import DefaultWeightInitializer
 from flexflow_tpu.ops.base import DimRole, Op, OpContext, register_op
 
 
-def rotary_embedding(x, *, theta: float = 10000.0):
+def rotary_embedding(x, *, theta: float = 10000.0, position_offset=0):
     """Apply RoPE to [B, H, S, D] (HF Llama rotate-half convention):
-    positions 0..S-1, inv_freq = theta^(-2i/D)."""
+    positions offset..offset+S-1, inv_freq = theta^(-2i/D).
+    ``position_offset`` (static or traced scalar) is the absolute
+    position of the first row — the incremental-decode path rotates the
+    new token at its true position, not at 0."""
     b, h, s, d = x.shape
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    pos = position_offset + jnp.arange(s, dtype=jnp.float32)
+    angles = pos[:, None] * inv_freq[None, :]
     cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)  # [S, D]
     sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
@@ -226,6 +230,101 @@ class MultiHeadAttention(Op):
         if self.use_bias:
             y = y + params["bo"]
         return [y.astype(query.dtype)]
+
+    def decode_forward(self, params, inputs, ctx: OpContext,
+                       k_cache, v_cache, pos):
+        """KV-cache incremental forward (flexflow_tpu/serve/kv_cache.py).
+
+        ``inputs``: the NEW token block only — query/key/value rows
+        ``[B, T, E]`` at absolute positions ``pos..pos+T-1`` (prefill is
+        T = prompt length at pos 0; decode is T = 1). ``k_cache`` /
+        ``v_cache``: ``[B, Hk, S_max, D]`` with positions < ``pos``
+        already filled. Projects the new rows, writes them into the
+        cache at ``pos``, and attends the new queries over the filled
+        prefix + themselves with the exact causal mask — so prefill +
+        N decode steps is numerically the full-sequence forward
+        restricted to the last row, without recomputing prior K/V.
+        Returns ``(y [B, T, E], k_cache, v_cache)``.
+
+        Only causal attention has a valid incremental decomposition
+        (a bidirectional row would need future K/V that doesn't exist
+        yet); non-causal ops refuse rather than silently drift.
+        """
+        if not self.causal:
+            raise NotImplementedError(
+                f"attention '{self.name}': KV-cache incremental decode "
+                f"requires causal attention (bidirectional rows depend "
+                f"on future positions)")
+        query, key, value = (inputs + inputs[:1] * 2)[:3] \
+            if len(inputs) == 1 else inputs
+        cd = ctx.compute_dtype
+        q = jnp.einsum("bse,hed->bhsd", query.astype(cd),
+                       params["wq"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        k = jnp.einsum("bse,hed->bhsd", key.astype(cd),
+                       params["wk"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        v = jnp.einsum("bse,hed->bhsd", value.astype(cd),
+                       params["wv"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        if self.qkv_bias and "bq" in params:
+            q = q + params["bq"][None, :, None, :]
+            k = k + params["bk"][None, :, None, :]
+            v = v + params["bv"][None, :, None, :]
+        if self.rope:
+            q = rotary_embedding(q, theta=self.rope_theta,
+                                 position_offset=pos)
+            k = rotary_embedding(k, theta=self.rope_theta,
+                                 position_offset=pos)
+        # write the new rows into the cache at their absolute positions
+        # (cache dtype is the cache's own policy — serve keeps bf16/f32
+        # per the executor compute dtype)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+        b, _, t, d = q.shape
+        s_max = k_cache.shape[2]
+        rep = self.num_heads // self.num_kv_heads
+        # GQA: contract the grouped query heads against the UN-expanded
+        # cache (a jnp.repeat here would materialize rep x the whole
+        # cache's bytes every decode step — the cache read dominates a
+        # single-token step)
+        grouped = rep > 1
+        if grouped:
+            qq = q.reshape(b, self.num_kv_heads, rep, t, d)
+            scores = jnp.einsum("bgrqd,bgkd->bgrqk", qq.astype(cd),
+                                k_cache.astype(cd),
+                                preferred_element_type=jnp.float32)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(cd),
+                                k_cache.astype(cd),
+                                preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(self.head_dim))
+        # causal over absolute positions: key j visible to the query at
+        # absolute position pos+i iff j <= pos+i (this also masks every
+        # not-yet-written cache slot, since those have j >= pos+t)
+        qpos = pos + jnp.arange(t)[:, None]
+        visible = jnp.arange(s_max)[None, :] <= qpos
+        scores = jnp.where(visible[(None, None, None) if grouped
+                                   else (None, None)],
+                           scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if grouped:
+            o = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(cd),
+                           v_cache.astype(cd),
+                           preferred_element_type=jnp.float32
+                           ).reshape(b, self.num_heads, t, d)
+        else:
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cd),
+                           v_cache.astype(cd),
+                           preferred_element_type=jnp.float32)
+        y = jnp.einsum("bhsd,hde->bse", o.astype(cd),
+                       params["wo"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        if self.use_bias:
+            y = y + params["bo"]
+        return y.astype(query.dtype), k_cache, v_cache
 
     def output_dim_roles(self):
         return [(DimRole.SAMPLE, DimRole.SEQ, DimRole.CHANNEL)]

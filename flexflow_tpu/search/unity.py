@@ -366,13 +366,23 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
         new_nodes, new_final = apply_rewrites(nodes, resp["rewrites"],
                                               final_ref)
     mesh_axes, strategy = decode_strategy(resp, new_nodes)
+    # the search OBJECTIVE is part of the answer's provenance: TRAINING
+    # minimizes simulated step time (fwd+bwd+update+sync), INFERENCE
+    # minimizes simulated per-batch latency (forward only, no gradient
+    # sync / '_wus' / opt-state terms) — the serving engine records it
+    # per batch bucket and the strategy/search-trace artifacts carry it
+    training_mode = request["config"]["training"]
+    objective = "step_time" if training_mode else "latency"
     info = dict(predicted_time=resp.get("predicted_time"),
                 predicted_memory=resp.get("predicted_memory"),
                 memory_correction=mem_correction,
+                objective=objective,
                 stats=resp.get("stats", {}),
                 rewrites=resp.get("rewrites", []))
     if resp.get("search_trace"):
-        info["search_trace"] = resp["search_trace"]
+        trace = dict(resp["search_trace"])
+        trace.setdefault("objective", objective)
+        info["search_trace"] = trace
     if resp.get("overlap"):
         # byte-weighted winning bucket size across the '_ovl' choices —
         # the searched value --overlap-bucket-mb 'auto' follows
@@ -413,7 +423,7 @@ def _memory_correction() -> float:
 # ---- strategy files (--export-strategy / --import-strategy) ---------------
 
 def strategy_json(mesh_axes: Dict[str, int], strategy: Strategy,
-                  nodes) -> Dict[str, Any]:
+                  nodes, objective: Optional[str] = None) -> Dict[str, Any]:
     """Strategy keyed by op *name* (stable across runs, unlike guids —
     the reference keys by FFConfig::get_hash_id, strategy.cc:26) as a
     JSON-able dict: the body of a strategy file, also embedded verbatim
@@ -430,13 +440,21 @@ def strategy_json(mesh_axes: Dict[str, int], strategy: Strategy,
             outputs=[list(s) if s is not None else None for s in st.output_specs],
             params={k: list(v) for k, v in st.param_specs.items()},
         )
-    return dict(version=1, mesh=dict(mesh_axes), ops=ops)
+    out = dict(version=1, mesh=dict(mesh_axes), ops=ops)
+    if objective:
+        # "step_time" (TRAINING) vs "latency" (INFERENCE serving): a
+        # strategy file / checkpoint manifest records which objective
+        # the recorded shardings were searched under
+        out["objective"] = objective
+    return out
 
 
 def export_strategy_file(path: str, mesh_axes: Dict[str, int],
-                         strategy: Strategy, nodes) -> None:
+                         strategy: Strategy, nodes,
+                         objective: Optional[str] = None) -> None:
     with open(path, "w") as f:
-        json.dump(strategy_json(mesh_axes, strategy, nodes), f, indent=1)
+        json.dump(strategy_json(mesh_axes, strategy, nodes,
+                                objective=objective), f, indent=1)
 
 
 def import_strategy_file(path: str, nodes) -> Tuple[Dict[str, int], Strategy]:

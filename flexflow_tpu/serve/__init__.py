@@ -1,0 +1,56 @@
+"""flexflow_tpu/serve — production inference serving.
+
+The other half of the north star ("serve heavy traffic from millions of
+users"): training optimizes step *throughput*; serving optimizes request
+*latency at batch*. This package turns a trained model — live in
+process, or a v2 per-shard checkpoint manifest on disk — into a serving
+runtime built from the framework's own machinery:
+
+* **latency-objective strategy search** (``engine``): INFERENCE-mode
+  ``graph_optimize`` prices the forward pass only (no gradient sync, no
+  ``_wus``/``_ovl`` twins, no optimizer-state memory) so each batch
+  bucket gets its own searched sharding that minimizes simulated
+  per-batch latency — nobody else auto-searches inference shardings
+  per bucket;
+* **continuous/dynamic batching** (``batching``): a request queue +
+  size-or-deadline scheduler that closes batches, pads them into the
+  bucket executors, and returns per-request results, with p50/p99
+  request latency, queue depth, and batch-occupancy flowing through the
+  obs registry;
+* **sharded KV-cache decode** (``kv_cache``): for the causal attention
+  family the KV cache is a first-class sharded tensor (sequence axis on
+  the ring-attention 'seq' mesh axis, head axis under model
+  parallelism) with a prefill + incremental-decode path parity-tested
+  against full-sequence recompute;
+* **train-anywhere / serve-anywhere** (``loader``):
+  ``load_for_serving`` reads a training checkpoint manifest on a
+  *different* mesh, re-searches inference shardings for the live
+  topology (``ckpt/elastic.plan_resume`` decides reuse vs re-search),
+  re-places the params, and serves the Conv+BN-folded predict —
+  numerically equivalent to the training-mesh predict;
+* **closed-loop load generation** (``loadgen``): the driver behind
+  ``scripts/serve_bench.py`` and the ``bench.py serve`` latency
+  ratchets.
+"""
+
+from flexflow_tpu.serve.batching import (BatchScheduler, Request,
+                                         RequestQueue, pad_to_bucket,
+                                         pick_bucket)
+from flexflow_tpu.serve.engine import ServingEngine
+from flexflow_tpu.serve.kv_cache import DecodeSession, init_kv_cache
+from flexflow_tpu.serve.loader import load_for_serving
+from flexflow_tpu.serve.loadgen import run_closed_loop, run_serve_smoke
+
+__all__ = [
+    "BatchScheduler",
+    "DecodeSession",
+    "Request",
+    "RequestQueue",
+    "ServingEngine",
+    "init_kv_cache",
+    "load_for_serving",
+    "pad_to_bucket",
+    "pick_bucket",
+    "run_closed_loop",
+    "run_serve_smoke",
+]

@@ -1,0 +1,135 @@
+"""Train-anywhere / serve-anywhere: deploy a checkpoint manifest.
+
+The v2 per-shard checkpoint (flexflow_tpu/ckpt) already records
+everything a serving fleet needs: logically-global arrays behind a
+shard index, the mesh they were saved on, and the strategy they trained
+under. ``load_for_serving`` turns that manifest into a compiled
+INFERENCE model on whatever topology is live HERE:
+
+1. ``ckpt/elastic.plan_resume`` classifies the live device count
+   against the saving mesh (reuse vs re-search);
+2. the model compiles in ``CompMode.INFERENCE`` — by default with a
+   search budget, so the native DP re-searches *latency-objective*
+   shardings for the serving topology (a training-optimal sharding is
+   rarely latency-optimal; see serve/engine.py). With search
+   unavailable, a same-topology deploy reuses the recorded strategy
+   verbatim and a changed topology takes the heuristic default;
+3. ``ckpt/sharded.load_sharded(include_opt_state=False)`` reassembles
+   the params + op state from the shard index — skipping the optimizer
+   moments entirely (an INFERENCE compile allocates none) — and
+   re-places them onto the new strategy's NamedShardings;
+4. the inference executables run the Conv+BN-folded graph
+   (``GraphExecutor._inference_nodes``), so the deployed predict is the
+   fused-kernel path.
+
+The result predicts numerically equivalently to the training-mesh
+model (tests/test_serve.py asserts it cross-mesh), and ``.serve()`` on
+it starts the continuous-batching engine.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+from typing import Optional
+
+from flexflow_tpu.ffconst import CompMode, LossType
+from flexflow_tpu.obs.registry import get_registry
+
+
+def load_for_serving(manifest_dir: str, ff, *,
+                     mesh=None,
+                     search_budget: Optional[int] = None,
+                     loss_type: LossType = None,
+                     machine_spec=None,
+                     verify: bool = True):
+    """Compile ``ff`` (a built, NOT-yet-compiled FFModel whose layer
+    graph matches the checkpointed model) for INFERENCE on the live
+    topology and restore the manifest's params onto it.
+
+    ``mesh`` forces an explicit serving mesh (skipping the search);
+    ``search_budget`` (default: 8 when the native search is available,
+    else 0) re-searches latency-objective shardings; ``verify=False``
+    skips shard CRC verification on restore. Returns ``ff``, compiled
+    and loaded, with ``ff.serve_load_info`` describing what happened.
+    """
+    import jax
+
+    from flexflow_tpu.ckpt import elastic, sharded
+    from flexflow_tpu.search.native import available as _native_available
+
+    t0 = time.perf_counter()
+    manifest = elastic.load_manifest(manifest_dir)
+    n_live = int(mesh.devices.size) if mesh is not None \
+        else len(jax.devices())
+    plan = elastic.plan_resume(manifest, n_live)
+    if search_budget is None:
+        search_budget = 8 if (_native_available() and mesh is None) else 0
+
+    cfg = ff.config
+    # every compile-steering knob this loader touches is restored after
+    # the compile — the config object may be shared with other models,
+    # and a deploy must not leave a surprise budget-8 search behind
+    saved_knobs = {k: getattr(cfg, k)
+                   for k in ("search_budget", "enable_parameter_parallel",
+                             "only_data_parallel", "import_strategy_file")}
+    strategy_tmp = None
+    mode = "heuristic"
+    if mesh is not None:
+        mode = "explicit-mesh"
+    elif search_budget > 0:
+        # latency-objective re-search for the serving topology — even
+        # on the saving topology the INFERENCE objective may pick a
+        # different sharding than training did, and that is the point
+        cfg.search_budget = int(search_budget)
+        cfg.enable_parameter_parallel = True
+        cfg.only_data_parallel = False
+        mode = "latency-research"
+    elif plan["action"] == "reuse" and manifest.get("strategy"):
+        # no search available but the topology matches: the recorded
+        # strategy applies verbatim (ckpt/elastic fast path)
+        fd, strategy_tmp = tempfile.mkstemp(suffix=".strategy.json")
+        os.close(fd)
+        elastic.write_saved_strategy(manifest, strategy_tmp)
+        cfg.import_strategy_file = strategy_tmp
+        mode = "reused-saved-strategy"
+
+    try:
+        ff.compile(optimizer=None,
+                   loss_type=loss_type or LossType.
+                   SPARSE_CATEGORICAL_CROSSENTROPY,
+                   comp_mode=CompMode.INFERENCE,
+                   machine_spec=machine_spec, mesh=mesh)
+    finally:
+        if strategy_tmp is not None:
+            try:
+                os.unlink(strategy_tmp)
+            except OSError:
+                pass
+        for k, v in saved_knobs.items():
+            setattr(cfg, k, v)
+    # INFERENCE compile allocates no optimizer state — skip those
+    # leaves at restore (no reads, no reassembly)
+    it = sharded.load_sharded(manifest_dir, ff, verify=verify,
+                              include_opt_state=False)
+    reg = get_registry()
+    reg.gauge("serve/load_restore_s", time.perf_counter() - t0)
+    live_axes = dict(zip(ff.mesh.axis_names,
+                         (int(d) for d in ff.mesh.devices.shape)))
+    ff.serve_load_info = dict(
+        step=int(manifest.get("step", it)),
+        iteration=it,
+        plan=plan,
+        mode=mode,
+        saved_mesh=plan["saved_mesh"],
+        live_mesh=live_axes,
+        saved_objective=(manifest.get("strategy") or {}).get("objective"),
+        objective=getattr(ff, "search_objective", None),
+        cross_mesh=not elastic.strategy_matches_mesh(manifest, ff.mesh),
+    )
+    if os.environ.get("FFS_SERVE_VERBOSE"):
+        print(f"[serve] load_for_serving: {ff.serve_load_info}",
+              file=sys.stderr)
+    return ff

@@ -1,0 +1,284 @@
+"""Closed-loop load generation + the tier-1 serve smoke.
+
+Closed-loop protocol (the BENCH_NOTES r14 methodology): ``concurrency``
+client threads each keep exactly one request outstanding — submit, wait
+for the result, submit the next — so offered load adapts to service
+rate instead of queueing unboundedly (open-loop would measure queue
+growth, not the system). Warmup requests are excluded from the reported
+distribution: the first batch per bucket pays jit compilation, which is
+deploy-time cost, not serving latency.
+
+``run_serve_smoke`` is the non-fatal ``run_t1.sh`` stage: a tiny model,
+in-process requests through the full engine path, asserting that the
+latency gauges landed in the obs registry and writing the
+``*.serve.json`` artifact into the trace dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.obs.registry import get_registry, percentile
+
+
+def warm_buckets(engine, make_request: Callable[[int], Any],
+                 timeout_s: float = 300.0) -> int:
+    """Drive EVERY bucket once at full occupancy on the caller's
+    thread: each bucket's jit compile is paid here, outside both the
+    measured distribution and the registry latency reservoir. Serial
+    warmup of N requests would only ever warm the smallest bucket —
+    a mid-measurement batch would then record a compile as a p99
+    sample. Returns the number of warmup requests served."""
+    engine.record_latency = False
+    try:
+        i = 0
+        for b in engine.scheduler.buckets:
+            reqs = [engine.submit(make_request(i + j)) for j in range(b)]
+            i += b
+            engine.pump()
+            for r in reqs:
+                r.wait(timeout_s)
+    finally:
+        engine.record_latency = True
+    return i
+
+
+def run_closed_loop(engine, make_request: Callable[[int], Any],
+                    num_requests: int, concurrency: int = 4,
+                    warmup: int = 0,
+                    timeout_s: float = 120.0) -> Dict[str, Any]:
+    """Drive ``engine`` (a started ServingEngine) closed-loop.
+
+    ``make_request(i)`` builds request ``i``'s input list (one array
+    per model input, no batch dim). ``warmup`` initial requests are
+    served serially before measurement starts and excluded from the
+    stats — NOTE serial warmup only exercises the smallest bucket;
+    callers measuring multi-bucket engines should ``warm_buckets``
+    first (run_serve_workload and the smoke do).
+    Returns ``{p50_s, p99_s, mean_s, throughput_rps, num_measured,
+    errors, wall_s}``.
+    """
+    # warmup: outside the measurement and the registry reservoir
+    engine.record_latency = False
+    try:
+        for i in range(warmup):
+            engine.submit(make_request(i)).wait(timeout_s)
+    finally:
+        engine.record_latency = True
+
+    latencies: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    counter = [0]
+
+    def client():
+        while True:
+            with lock:
+                if counter[0] >= num_requests:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            req = engine.submit(make_request(warmup + i))
+            try:
+                req.wait(timeout_s)
+                with lock:
+                    latencies.append(req.latency_s)
+            except BaseException as e:
+                with lock:
+                    errors.append(f"req {req.id}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, daemon=True,
+                                name=f"serve-client{c}")
+               for c in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    s = sorted(latencies)
+    out: Dict[str, Any] = dict(
+        num_measured=len(s),
+        errors=errors,
+        wall_s=wall,
+        throughput_rps=(len(s) / wall if wall > 0 else 0.0),
+    )
+    if s:
+        out.update(p50_s=percentile(s, 0.50), p99_s=percentile(s, 0.99),
+                   mean_s=sum(s) / len(s))
+    return out
+
+
+def serve_report(engine, loop_stats: Dict[str, Any]) -> Dict[str, Any]:
+    """The serve artifact payload: closed-loop stats + per-bucket
+    search provenance + the registry's serve/* series."""
+    from flexflow_tpu.serve.batching import registry_latency_stats
+
+    return dict(
+        closed_loop=loop_stats,
+        buckets=engine.bucket_report(),
+        registry=registry_latency_stats(),
+    )
+
+
+def write_serve_artifact(trace_dir: str, report: Dict[str, Any],
+                         stem: str = "serve") -> str:
+    from flexflow_tpu.obs.artifacts import write_artifact
+
+    path = os.path.join(trace_dir, f"{stem}.serve.json")
+    return write_artifact(path, report, kind="serve")
+
+
+def serve_workload(name: str = "transformer", on_cpu: bool = True):
+    """One serving workload definition (shared by ``bench.py serve``
+    and ``scripts/serve_bench.py``): returns ``(cfg, build, loss,
+    make_request)`` where ``build()`` constructs the UNCOMPILED model
+    graph (the manifest-deploy path hands it to ``load_for_serving``,
+    which owns the compile) and ``make_request(i)`` builds request
+    ``i``'s input list (per-sample, no batch dim)."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+
+    rs = np.random.RandomState(0)
+    if name == "transformer":
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        cfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                                 seq_length=64, batch_size=8)
+               if on_cpu else TransformerConfig())
+        samples = rs.randn(64, cfg.seq_length,
+                           cfg.hidden_size).astype(np.float32)
+        return (cfg,
+                lambda: create_transformer(
+                    cfg, FFConfig(batch_size=cfg.batch_size)),
+                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                lambda i: [samples[i % len(samples)]])
+    if name == "llama":
+        from flexflow_tpu.models.llama import (LlamaModelConfig,
+                                               create_llama)
+        cfg = (LlamaModelConfig(batch_size=8, seq_length=32,
+                                num_hidden_layers=2)
+               if on_cpu else
+               LlamaModelConfig(batch_size=8, seq_length=512,
+                                hidden_size=1024, intermediate_size=4096,
+                                num_hidden_layers=8,
+                                num_attention_heads=16,
+                                num_key_value_heads=4, vocab_size=32000))
+        samples = rs.randint(0, cfg.vocab_size,
+                             (64, cfg.seq_length)).astype(np.int32)
+        return (cfg,
+                lambda: create_llama(cfg,
+                                     FFConfig(batch_size=cfg.batch_size)),
+                LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                lambda i: [samples[i % len(samples)]])
+    raise ValueError(f"unknown serve workload '{name}' "
+                     f"(transformer|llama)")
+
+
+def build_serve_model(name: str = "transformer", on_cpu: bool = True):
+    """Compiled-for-INFERENCE serving workload model. Returns
+    ``(ff, make_request, config_dict)``."""
+    import dataclasses as _dc
+
+    from flexflow_tpu.ffconst import CompMode
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    cfg, build, loss, make = serve_workload(name, on_cpu)
+    ff = build()
+    ff.compile(SGDOptimizer(lr=0.01), loss, [],
+               comp_mode=CompMode.INFERENCE)
+    return ff, make, _dc.asdict(cfg)
+
+
+def run_serve_workload(ff, make_request, num_requests: int = 40,
+                       concurrency: int = 4, buckets=None,
+                       max_wait_ms: float = 2.0,
+                       search_budget: Optional[int] = None,
+                       trace_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Serve ``num_requests`` closed-loop through a fresh engine and
+    return the serve report (closed-loop p50/p99, per-bucket search
+    provenance, registry serve/* series). Warmup: every bucket is
+    driven once at full occupancy BEFORE measurement so jit compiles
+    are deploy cost, not request latency."""
+    engine = ff.serve(batch_buckets=buckets, max_wait_ms=max_wait_ms,
+                      search_budget=search_budget)
+    warm_buckets(engine, make_request)
+    engine.start()
+    try:
+        stats = run_closed_loop(engine, make_request, num_requests,
+                                concurrency=concurrency, warmup=0)
+    finally:
+        engine.stop()
+    report = serve_report(engine, stats)
+    if trace_dir:
+        report["artifact"] = write_serve_artifact(trace_dir, report)
+    return report
+
+
+def run_serve_smoke(trace_dir: Optional[str] = None,
+                    num_requests: int = 12) -> Dict[str, Any]:
+    """Tiny in-process serve leg (the non-fatal run_t1.sh stage): build
+    a small MLP, serve ``num_requests`` closed-loop requests through
+    the continuous-batching engine, assert the latency gauges exist and
+    results match direct predict, and drop the ``*.serve.json``
+    artifact into ``trace_dir`` (default ``FFS_T1_TRACE_DIR``)."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import CompMode, LossType
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    trace_dir = trace_dir or os.environ.get("FFS_T1_TRACE_DIR")
+    bs = 8
+    ff = FFModel(FFConfig(batch_size=bs))
+    x = ff.create_tensor((bs, 16), name="x")
+    t = ff.dense(x, 32, name="h1")
+    t = ff.relu(t)
+    t = ff.dense(t, 4, name="head")
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               comp_mode=CompMode.INFERENCE)
+    engine = ff.serve(batch_buckets=(1, 4, 8), max_wait_ms=2.0,
+                      search_budget=0)
+    rs = np.random.RandomState(0)
+    samples = [rs.randn(16).astype(np.float32)
+               for _ in range(num_requests)]
+    make = lambda i: [samples[i % len(samples)]]
+    warm_buckets(engine, make)  # every bucket's compile outside the stats
+    engine.start()
+    try:
+        stats = run_closed_loop(engine, make, num_requests, concurrency=3)
+    finally:
+        engine.stop()
+    # per-request results must match the direct predict path
+    req = engine.submit([samples[0]])
+    engine.pump()
+    direct = ff.predict(np.stack([samples[0]] * bs))[0]
+    got = req.wait(10)
+    if not np.allclose(got, direct, atol=1e-5):
+        raise AssertionError(
+            f"serve result diverges from predict: {got} vs {direct}")
+    reg = get_registry().to_dict()
+    obs = reg.get("observations", {})
+    for series in ("serve/request_latency_s", "serve/batch_occupancy"):
+        if not obs.get(series, {}).get("count"):
+            raise AssertionError(
+                f"serve smoke: registry series '{series}' missing/empty")
+    if stats.get("errors"):
+        raise AssertionError(f"serve smoke errors: {stats['errors']}")
+    report = serve_report(engine, stats)
+    if trace_dir:
+        report["artifact"] = write_serve_artifact(trace_dir, report,
+                                                  stem="t1_smoke")
+    print("serve smoke ok: " + json.dumps(dict(
+        p50_s=round(stats.get("p50_s", 0.0), 6),
+        p99_s=round(stats.get("p99_s", 0.0), 6),
+        rps=round(stats.get("throughput_rps", 0.0), 2),
+        requests=stats.get("num_measured"),
+    )))
+    return report

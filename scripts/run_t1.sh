@@ -88,5 +88,17 @@ from flexflow_tpu.multihost_dryrun import run_supervised_dryrun
 run_supervised_dryrun()
 " > /tmp/_t1_supervised.out 2>&1; sup_rc=$?
 if [ "$sup_rc" -ne 0 ]; then echo "SUPERVISED: kill/hang auto-resume legs failed (exit $sup_rc, see /tmp/_t1_supervised.out) — non-fatal"; else echo "SUPERVISED: $(grep -a 'supervised dryrun ok' /tmp/_t1_supervised.out | head -1)"; fi
+# Serve stage (ISSUE 13, non-fatal): in-process continuous-batching smoke —
+# a tiny model served through the full flexflow_tpu/serve engine path
+# (request queue -> size-or-deadline scheduler -> padded bucket executor ->
+# per-request results). The smoke itself asserts the request-latency and
+# batch-occupancy gauges landed in the obs registry, that served results
+# match the direct predict path, and writes the *.serve.json artifact
+# into the tier-1 trace dir.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -c "
+from flexflow_tpu.serve.loadgen import run_serve_smoke
+run_serve_smoke()
+" > /tmp/_t1_serve.out 2>&1; serve_rc=$?
+if [ "$serve_rc" -ne 0 ]; then echo "SERVE: smoke failed (exit $serve_rc, see /tmp/_t1_serve.out) — non-fatal"; else echo "SERVE: $(grep -a 'serve smoke ok' /tmp/_t1_serve.out | head -1)"; fi
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
 exit $rc

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Closed-loop serving benchmark CLI (flexflow_tpu/serve).
+
+Drives ``Model.serve()`` — continuous batching over latency-searched
+bucket executors — with the closed-loop load generator and prints one
+JSON report: request-latency p50/p99 (warmup excluded), throughput,
+batch occupancy, and each bucket's searched objective/mesh. The
+ratcheted version of this run is ``bench.py serve``; this CLI is the
+knob-turning tool (sweep concurrency, deadlines, buckets, models).
+
+Usage:
+    python scripts/serve_bench.py --model transformer --requests 64 \
+        --concurrency 8 --max-wait-ms 2 --budget 4 [--buckets 1,4,8] \
+        [--manifest-dir CKPT_DIR] [--trace-dir DIR]
+
+``--manifest-dir`` serves a v2 checkpoint instead of fresh weights:
+the train-anywhere/serve-anywhere path (serve.load_for_serving) loads
+the manifest onto THIS machine's topology with re-searched inference
+shardings before serving.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="transformer",
+                    choices=("transformer", "llama"))
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--budget", type=int, default=4,
+                    help="latency-search budget per bucket (0 = reuse "
+                         "the training strategy)")
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets (default: "
+                         "powers of two up to the model batch)")
+    ap.add_argument("--manifest-dir", default=None,
+                    help="serve a v2 checkpoint manifest (train-"
+                         "anywhere/serve-anywhere) instead of fresh "
+                         "weights")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write the *.serve.json artifact here")
+    args = ap.parse_args()
+
+    from bench import ensure_virtual_host_devices
+    ensure_virtual_host_devices()
+
+    import dataclasses
+
+    import jax
+
+    from flexflow_tpu.serve.loadgen import (build_serve_model,
+                                            run_serve_workload,
+                                            serve_workload)
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if args.manifest_dir:
+        # deploy the checkpoint manifest onto this topology — the
+        # uncompiled graph goes straight to load_for_serving (which
+        # owns the compile); no throwaway fresh-weights compile
+        from flexflow_tpu.serve import load_for_serving
+        wcfg, build, loss, make_request = serve_workload(args.model,
+                                                         on_cpu)
+        ff = load_for_serving(args.manifest_dir, build(),
+                              search_budget=args.budget, loss_type=loss)
+        cfg = dataclasses.asdict(wcfg)
+    else:
+        ff, make_request, cfg = build_serve_model(args.model, on_cpu)
+    buckets = ([int(b) for b in args.buckets.split(",")]
+               if args.buckets else None)
+    report = run_serve_workload(
+        ff, make_request, num_requests=args.requests,
+        concurrency=args.concurrency, buckets=buckets,
+        max_wait_ms=args.max_wait_ms, search_budget=args.budget,
+        trace_dir=args.trace_dir)
+    loop = report["closed_loop"]
+    out = dict(
+        model=args.model,
+        platform="cpu" if on_cpu else "tpu",
+        p50_s=round(loop.get("p50_s", 0.0), 6),
+        p99_s=round(loop.get("p99_s", 0.0), 6),
+        mean_s=round(loop.get("mean_s", 0.0), 6),
+        throughput_rps=round(loop.get("throughput_rps", 0.0), 2),
+        num_measured=loop.get("num_measured"),
+        errors=loop.get("errors"),
+        buckets=report["buckets"],
+        occupancy_mean=report.get("registry", {}).get("occupancy_mean"),
+        config=cfg,
+    )
+    if args.manifest_dir:
+        out["serve_load_info"] = getattr(ff, "serve_load_info", None)
+    if report.get("artifact"):
+        out["artifact"] = report["artifact"]
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

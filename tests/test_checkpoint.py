@@ -625,3 +625,110 @@ class TestInspectCli:
         open(p, "wb").write(bytes(raw))
         assert main([str(tmp_path / "good"), "--json"]) == 1
         assert inspect(str(tmp_path / "good"))["latest"]["errors"]
+
+
+class TestChunkedShards:
+    """Shard-file chunking (ROADMAP elastic follow-on (b), ISSUE 13
+    satellite): payloads above FFS_CKPT_CHUNK_BYTES split into CRC'd
+    chunks at write, reassemble at load, verify deep-checks every
+    chunk, and the serving loader's reads are capped at chunk size."""
+
+    def _save_chunked(self, tmp_path, monkeypatch, threshold="128"):
+        monkeypatch.setenv("FFS_CKPT_CHUNK_BYTES", threshold)
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        step_dir = save_sharded(str(tmp_path), ff)
+        return ff, step_dir
+
+    def test_roundtrip_bitwise_and_chunks_on_disk(self, tmp_path,
+                                                  monkeypatch):
+        import glob
+        import json as _json
+
+        ff, step_dir = self._save_chunked(tmp_path, monkeypatch)
+        # chunks actually materialized (h1 kernel is 16x32 f32 = 2KB+)
+        rows = []
+        for f in glob.glob(os.path.join(step_dir, "index_host*.json")):
+            idx = _json.load(open(f))
+            for leaf, rr in idx["shards"].items():
+                rows.extend(rr)
+        chunked = [r for r in rows if r.get("chunks")]
+        assert chunked, "no shard exceeded the 128B chunk threshold"
+        for r in chunked:
+            assert sum(c["bytes"] for c in r["chunks"]) == r["bytes"]
+            assert all(c["bytes"] <= 128 for c in r["chunks"][:-1])
+        # loads back bit-identically (threshold also active at load —
+        # reader handles chunked rows regardless of the env)
+        ff2 = small_model()
+        assert load_sharded(str(tmp_path), ff2) == ff._iter
+        assert_tree_bitwise(ff.params, ff2.params, "params")
+        assert_tree_bitwise(ff.opt_state["m"], ff2.opt_state["m"], "m")
+
+    def test_verify_step_dir_checks_chunks(self, tmp_path, monkeypatch):
+        import glob
+
+        ff, step_dir = self._save_chunked(tmp_path, monkeypatch)
+        rep = verify_step_dir(step_dir, deep=True)
+        assert rep["complete"], rep["errors"]
+        # flip a byte inside a chunk entry: deep verify must flag it
+        p = glob.glob(os.path.join(step_dir, "shards_host*.npz"))[0]
+        raw = bytearray(open(p, "rb").read())
+        k = raw.find(b"::c0.npy")
+        assert k > 0, "no chunk entries in npz"
+        raw[k + 200] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        rep = verify_step_dir(step_dir, deep=True)
+        assert not rep["complete"]
+        assert any("c0" in e or "unreadable" in e for e in rep["errors"])
+
+    def test_chunk_corruption_detected_at_load(self, tmp_path,
+                                               monkeypatch):
+        import glob
+
+        ff, step_dir = self._save_chunked(tmp_path, monkeypatch)
+        p = glob.glob(os.path.join(step_dir, "shards_host*.npz"))[0]
+        data = dict(np.load(p))
+        ck = [k for k in data if "::c" in k][0]
+        arr = data[ck].copy()
+        arr.flat[0] += 1.0
+        data[ck] = arr
+        np.savez(p, **data)
+        with pytest.raises(ValueError, match="corruption"):
+            load_sharded(str(tmp_path), small_model())
+
+    def test_default_threshold_leaves_small_shards_unchunked(
+            self, tmp_path):
+        import glob
+        import json as _json
+
+        x, y = blobs()
+        ff = small_model()
+        ff.fit(x, y, epochs=1, verbose=False)
+        step_dir = save_sharded(str(tmp_path), ff)
+        for f in glob.glob(os.path.join(step_dir, "index_host*.json")):
+            idx = _json.load(open(f))
+            for leaf, rr in idx["shards"].items():
+                assert all("chunks" not in r for r in rr)
+
+    def test_load_without_opt_state(self, tmp_path, monkeypatch):
+        """include_opt_state=False (the serving loader's path): params
+        and op state restore, optimizer leaves are never read, and the
+        live opt_state object is untouched."""
+        from flexflow_tpu.obs.registry import get_registry
+
+        ff, step_dir = self._save_chunked(tmp_path, monkeypatch)
+        ff2 = small_model()
+        sentinel = ff2.opt_state
+        before = get_registry().get("ckpt/restore_read_bytes")
+        assert load_sharded(str(tmp_path), ff2,
+                            include_opt_state=False) == ff._iter
+        assert ff2.opt_state is sentinel
+        assert_tree_bitwise(ff.params, ff2.params, "params")
+        # fewer bytes read than a full restore of the same checkpoint
+        partial = get_registry().get("ckpt/restore_read_bytes") - before
+        ff3 = small_model()
+        load_sharded(str(tmp_path), ff3)
+        full = (get_registry().get("ckpt/restore_read_bytes")
+                - before - partial)
+        assert partial < full

@@ -1,0 +1,525 @@
+"""flexflow_tpu/serve: latency-objective search, continuous batching,
+sharded KV-cache decode, train-anywhere/serve-anywhere (ISSUE 13).
+
+CPU, 8 virtual devices (conftest). The heavyweight legs (zoo-model
+Conv+BN-fold parity, latency-researched cross-mesh load) keep configs
+tiny; anything beyond them is @slow.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import CompMode, LossType
+from flexflow_tpu.machine import MachineSpec, make_mesh
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.optimizers import AdamOptimizer, SGDOptimizer
+
+RS = np.random.RandomState(0)
+
+
+def _mlp(bs=8, in_dim=16, out_dim=4, comp_mode=CompMode.INFERENCE):
+    ff = FFModel(FFConfig(batch_size=bs))
+    x = ff.create_tensor((bs, in_dim), name="x")
+    t = ff.dense(x, 32, name="h1")
+    t = ff.relu(t)
+    t = ff.dense(t, out_dim, name="head")
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               comp_mode=comp_mode)
+    return ff
+
+
+# ---------------------------------------------------------------------------
+# batching / scheduling (pure python)
+
+
+class TestBatching:
+    def test_queue_fifo_and_depth(self):
+        from flexflow_tpu.serve.batching import RequestQueue
+        q = RequestQueue()
+        r1 = q.submit([np.zeros(3)])
+        r2 = q.submit([np.ones(3)])
+        assert q.depth() == 2
+        got = q.pop_up_to(1)
+        assert got == [r1] and q.depth() == 1
+        assert q.pop_up_to(5) == [r2] and q.depth() == 0
+
+    def test_scheduler_size_close(self):
+        from flexflow_tpu.serve.batching import BatchScheduler, RequestQueue
+        q = RequestQueue()
+        s = BatchScheduler((2, 4), max_wait_s=3600)
+        for _ in range(3):
+            q.submit([np.zeros(2)])
+        assert s.poll(q) == []  # 3 < max bucket 4, nothing aged
+        q.submit([np.zeros(2)])
+        batch = s.poll(q)
+        assert len(batch) == 4  # size close at the largest bucket
+
+    def test_scheduler_deadline_close(self):
+        from flexflow_tpu.serve.batching import BatchScheduler, RequestQueue
+        q = RequestQueue()
+        s = BatchScheduler((4,), max_wait_s=0.01)
+        req = q.submit([np.zeros(2)])
+        assert s.poll(q, now=req.enqueue_t + 0.001) == []
+        batch = s.poll(q, now=req.enqueue_t + 0.02)
+        assert batch == [req]  # deadline close with a lone request
+
+    def test_scheduler_flush(self):
+        from flexflow_tpu.serve.batching import BatchScheduler, RequestQueue
+        q = RequestQueue()
+        s = BatchScheduler((8,), max_wait_s=3600)
+        q.submit([np.zeros(2)])
+        assert len(s.poll(q, flush=True)) == 1
+
+    def test_pick_bucket(self):
+        from flexflow_tpu.serve.batching import pick_bucket
+        assert pick_bucket(1, (1, 4, 8)) == 1
+        assert pick_bucket(3, (1, 4, 8)) == 4
+        assert pick_bucket(5, (1, 4, 8)) == 8
+        assert pick_bucket(9, (1, 4, 8)) == 8  # caller caps at max
+
+    def test_pad_to_bucket(self):
+        from flexflow_tpu.serve.batching import Request, pad_to_bucket
+        reqs = [Request([np.full((3,), i, np.float32)]) for i in range(3)]
+        arrays = pad_to_bucket(reqs, 4)
+        assert arrays[0].shape == (4, 3)
+        assert np.array_equal(arrays[0][:3, 0], [0, 1, 2])
+        assert np.all(arrays[0][3] == 0)  # padding rows are zeros
+        with pytest.raises(ValueError):
+            pad_to_bucket(reqs, 2)
+
+    def test_request_wait_timeout_and_error(self):
+        from flexflow_tpu.serve.batching import Request
+        r = Request([np.zeros(1)])
+        with pytest.raises(TimeoutError):
+            r.wait(0.01)
+        r.finish(error=RuntimeError("boom"))
+        with pytest.raises(RuntimeError):
+            r.wait(1)
+
+
+# ---------------------------------------------------------------------------
+# latency-objective search (native DP, no jit)
+
+
+def _native_or_skip():
+    from flexflow_tpu.search.native import available
+    if not available():
+        pytest.skip("native search unavailable")
+
+
+class TestLatencyObjective:
+    _cache = {}
+
+    def _strategies(self, batch=8, n_chips=8):
+        """(training, inference) native strategies for the transformer
+        zoo model on a simulated v4 slice. Cached per config — two
+        tests share one pair of native searches (tier-1 budget)."""
+        key = (batch, n_chips)
+        if key in self._cache:
+            return self._cache[key]
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.search.native import native_optimize
+        from flexflow_tpu.search.unity import (machine_to_json,
+                                               serialize_graph)
+        mcfg = TransformerConfig(num_layers=4, hidden_size=256,
+                                 num_heads=8, seq_length=64,
+                                 batch_size=batch)
+        ff = create_transformer(mcfg, FFConfig(batch_size=batch,
+                                               only_data_parallel=True,
+                                               workers_per_node=1))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+        nodes = serialize_graph(ff.executor.nodes)
+        machine = machine_to_json(
+            MachineSpec(chip="tpu-v4", chips_per_slice=n_chips), n_chips)
+        base = dict(budget=8, alpha=0.05, batch=batch, seed=42, rules=[],
+                    enable_parameter_parallel=True,
+                    enable_pipeline_parallel=False)
+        train = native_optimize(dict(
+            nodes=nodes, machine=machine, measured={},
+            config=dict(base, training=True, opt_state_factor=1.0)))
+        inf = native_optimize(dict(
+            nodes=nodes, machine=machine, measured={},
+            config=dict(base, training=False, opt_state_factor=0.0)))
+        self._cache[key] = (train, inf)
+        return train, inf
+
+    def test_inference_sharding_differs_from_training(self):
+        """Acceptance: the latency objective changes the answer on a
+        zoo model — the INFERENCE-searched sharding differs from the
+        TRAINING-searched one on the transformer."""
+        _native_or_skip()
+        train, inf = self._strategies()
+
+        def sig(resp):
+            return {k: (v.get("choice"),
+                        tuple(tuple(e) for e in v["outputs"]))
+                    for k, v in resp["ops"].items()}
+        assert sig(train) != sig(inf), (
+            "latency-objective search produced the training sharding")
+
+    def test_inference_strategy_has_no_training_only_choices(self):
+        """Forward-only pricing: no '_wus'/'_ovl' gradient-sync choice
+        twins can win under the INFERENCE objective (there is no
+        gradient sync to shard or hide)."""
+        _native_or_skip()
+        _, inf = self._strategies()
+        bad = [k for k, v in inf["ops"].items()
+               if any(t in (v.get("choice") or "")
+                      for t in ("_wus", "_ovl"))]
+        assert not bad, f"inference strategy carries training choices: {bad}"
+
+    def test_objective_recorded_in_info_and_strategy_json(self):
+        _native_or_skip()
+        from flexflow_tpu.search import unity as _unity
+        ff = _mlp(comp_mode=CompMode.TRAINING)
+        cfg = FFConfig(batch_size=8)
+        cfg.search_budget = 2
+        cfg.computation_mode = CompMode.INFERENCE
+        cfg.opt_state_factor = 0.0
+        mesh_axes, strategy, info = _unity.graph_optimize(
+            ff.executor.nodes, MachineSpec(chip="tpu-v4",
+                                           chips_per_slice=8),
+            cfg, 8, batch=8)
+        assert info["objective"] == "latency"
+        sj = _unity.strategy_json(mesh_axes, strategy, ff.executor.nodes,
+                                  objective=info["objective"])
+        assert sj["objective"] == "latency"
+        cfg.computation_mode = CompMode.TRAINING
+        cfg.opt_state_factor = 1.0
+        _, _, info_t = _unity.graph_optimize(
+            ff.executor.nodes, MachineSpec(chip="tpu-v4",
+                                           chips_per_slice=8),
+            cfg, 8, batch=8)
+        assert info_t["objective"] == "step_time"
+
+
+# ---------------------------------------------------------------------------
+# serving engine (continuous batching end to end)
+
+
+class TestServingEngine:
+    def test_results_match_predict_and_gauges_flow(self):
+        from flexflow_tpu.obs.registry import get_registry
+        ff = _mlp()
+        engine = ff.serve(batch_buckets=(1, 4, 8), max_wait_ms=1.0,
+                          search_budget=0)
+        samples = [RS.randn(16).astype(np.float32) for _ in range(6)]
+        reqs = [engine.submit([s]) for s in samples]
+        served = engine.pump()
+        assert served == 6
+        direct = ff.predict(np.stack(samples + samples[:2]))
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(r.wait(10), direct[i], atol=1e-5)
+        snap = get_registry().to_dict()
+        assert snap["observations"]["serve/request_latency_s"]["count"] >= 6
+        assert snap["observations"]["serve/batch_occupancy"]["count"] >= 1
+
+    def test_padded_bucket_and_occupancy(self):
+        ff = _mlp()
+        engine = ff.serve(batch_buckets=(4, 8), max_wait_ms=0.5,
+                          search_budget=0)
+        s = RS.randn(16).astype(np.float32)
+        req = engine.submit([s])
+        time.sleep(0.002)  # age past the deadline
+        assert engine.step() == 1  # deadline close -> padded into bucket 4
+        out = req.wait(10)
+        direct = ff.predict(np.stack([s] * 8))[0]
+        np.testing.assert_allclose(out, direct, atol=1e-5)
+
+    def test_background_thread_serving(self):
+        ff = _mlp()
+        engine = ff.serve(batch_buckets=(1, 8), max_wait_ms=0.5,
+                          search_budget=0, start=True)
+        try:
+            s = RS.randn(16).astype(np.float32)
+            out = engine.submit([s]).wait(30)
+            assert out.shape == (4,)
+        finally:
+            engine.stop()
+
+    def test_bucket_report_shape(self):
+        ff = _mlp()
+        engine = ff.serve(batch_buckets=(2, 8), search_budget=0)
+        rep = engine.bucket_report()
+        assert set(rep) == {"2", "8"}
+        for e in rep.values():
+            assert e["objective"] == "reused-training-strategy"
+            assert "strategy_differs_from_training" in e
+
+    def test_searched_buckets_record_latency_objective(self):
+        """Each bucket's searched objective is recorded — latency@batchN
+        when the native search priced it."""
+        _native_or_skip()
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        cfg = TransformerConfig(num_layers=2, hidden_size=64, num_heads=4,
+                                seq_length=16, batch_size=8)
+        ff = create_transformer(cfg, FFConfig(batch_size=8))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                   comp_mode=CompMode.INFERENCE)
+        engine = ff.serve(batch_buckets=(1, 8), max_wait_ms=0.5,
+                          search_budget=4)
+        rep = engine.bucket_report()
+        assert rep["1"]["objective"] == "latency@batch1"
+        assert rep["8"]["objective"] == "latency@batch8"
+        # and the engine still serves correctly under the searched
+        # shardings (bucket 1 typically picks a completely different
+        # mesh factorization than training)
+        x = RS.randn(cfg.seq_length, cfg.hidden_size).astype(np.float32)
+        req = engine.submit([x])
+        engine.pump()
+        direct = ff.predict(np.stack([x] * 8))[0]
+        np.testing.assert_allclose(req.wait(10), direct, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sharded KV-cache incremental decode
+
+
+class TestKVCacheDecode:
+    def _llama(self, seq_parallel=None):
+        from flexflow_tpu.models.llama import (LlamaModelConfig,
+                                               create_llama)
+        cfg = LlamaModelConfig(batch_size=2, seq_length=16,
+                               num_hidden_layers=2,
+                               seq_parallel=seq_parallel)
+        ff = create_llama(cfg, FFConfig(batch_size=2))
+        mesh = None
+        if seq_parallel:
+            mesh = make_mesh(8, {"data": 2, "seq": 4})
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                   comp_mode=CompMode.INFERENCE, mesh=mesh)
+        return ff, cfg
+
+    def test_prefill_and_decode_parity_vs_full_recompute(self):
+        """Acceptance: KV-cache incremental decode is parity-tested
+        against full-sequence recompute — prefill(8) + 8 single-token
+        decode steps reproduce predict()'s logits."""
+        from flexflow_tpu.serve.kv_cache import DecodeSession
+        ff, cfg = self._llama()
+        ids = RS.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        full = ff.predict(ids)
+        sess = DecodeSession(ff)
+        pre = sess.prefill([ids[:, :8]])
+        np.testing.assert_allclose(pre, full[:, :8], atol=2e-5)
+        steps = [sess.decode([ids[:, t:t + 1]]) for t in range(8, 16)]
+        inc = np.concatenate(steps, axis=1)
+        np.testing.assert_allclose(inc, full[:, 8:], atol=2e-5)
+        # the session is at max_len now: one more block must refuse
+        with pytest.raises(ValueError):
+            sess.decode([ids[:, :1]])
+
+    def test_cache_is_sharded_on_seq_axis(self):
+        """The cache is a first-class sharded tensor: with a 'seq' mesh
+        axis (ring-attention sharding) the cache's sequence dim shards
+        over it, and decode stays numerically correct."""
+        from flexflow_tpu.serve.kv_cache import DecodeSession, init_kv_cache
+        ff, cfg = self._llama(seq_parallel="seq")
+        caches = init_kv_cache(ff)
+        spec = next(iter(caches.values()))["k"].sharding.spec
+        assert spec[2] == "seq", f"cache seq dim not sharded: {spec}"
+        ids = RS.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        full = ff.predict(ids)
+        sess = DecodeSession(ff)
+        pre = sess.prefill([ids[:, :12]])
+        np.testing.assert_allclose(pre, full[:, :12], atol=2e-5)
+
+    @pytest.mark.slow
+    def test_generate_greedy(self):
+        from flexflow_tpu.serve.kv_cache import DecodeSession
+        ff, cfg = self._llama()
+        ids = RS.randint(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+        gen = DecodeSession(ff).generate(ids, steps=5)
+        assert gen.shape == (2, 9)
+        # greedy continuation must match argmax over the full forward
+        full = ff.predict(np.concatenate(
+            [gen, np.zeros((2, 16 - 9), np.int32)], axis=1))
+        assert np.array_equal(gen[:, 4],
+                              np.argmax(full[:, 3, :], axis=-1))
+
+    def test_non_causal_attention_refuses(self):
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.serve.kv_cache import init_kv_cache
+        cfg = TransformerConfig(num_layers=1, hidden_size=32, num_heads=2,
+                                seq_length=8, batch_size=2, causal=False)
+        ff = create_transformer(cfg, FFConfig(batch_size=2))
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                   comp_mode=CompMode.INFERENCE)
+        with pytest.raises(NotImplementedError):
+            init_kv_cache(ff)
+
+
+# ---------------------------------------------------------------------------
+# train-anywhere / serve-anywhere
+
+
+def _conv_bn_model(bs=8):
+    ff = FFModel(FFConfig(batch_size=bs))
+    x = ff.create_tensor((bs, 3, 16, 16), name="img")
+    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="c1")
+    t = ff.batch_norm(t, relu=True, name="bn1")
+    t = ff.conv2d(t, 8, 3, 3, 2, 2, 1, 1, name="c2")
+    t = ff.batch_norm(t, relu=True, name="bn2")
+    t = ff.flat(t)
+    t = ff.dense(t, 10, name="fc")
+    t = ff.softmax(t)
+    return ff
+
+
+class TestLoadForServing:
+    def _train_and_save(self, d):
+        from flexflow_tpu.ckpt import save_sharded
+        train = _conv_bn_model()
+        train.compile(AdamOptimizer(alpha=1e-3),
+                      LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                      mesh=make_mesh(4, {"data": 4}))
+        x = RS.randn(8, 3, 16, 16).astype(np.float32)
+        y = RS.randint(0, 10, (8, 1)).astype(np.int32)
+        train.fit(x, y, epochs=1, verbose=False)  # move BN stats
+        save_sharded(d, train)
+        return train, x
+
+    def test_cross_mesh_predict_equivalent(self):
+        """Acceptance: a training checkpoint saved on a {data:4} mesh
+        loads for serving on a DIFFERENT mesh and predicts numerically
+        equivalently (through the Conv+BN-folded inference path)."""
+        from flexflow_tpu.serve import load_for_serving
+        with tempfile.TemporaryDirectory() as d:
+            train, x = self._train_and_save(d)
+            ref = train.predict(x)
+            serve = load_for_serving(d, _conv_bn_model(),
+                                     mesh=make_mesh(2, {"data": 2}),
+                                     search_budget=0)
+            assert serve.serve_load_info["cross_mesh"]
+            assert serve.serve_load_info["plan"]["action"] == "research"
+            assert serve.opt_state is None  # INFERENCE: no optimizer state
+            np.testing.assert_allclose(serve.predict(x), ref, atol=1e-5)
+            # training model compiled without a search: manifest
+            # strategy carries no objective annotation
+            from flexflow_tpu.ckpt import elastic
+            manifest = elastic.load_manifest(d)
+            assert "objective" not in (manifest.get("strategy") or {})
+
+    def test_latency_research_mode(self):
+        """With the native search, load_for_serving re-searches
+        latency-objective shardings for the live topology."""
+        _native_or_skip()
+        from flexflow_tpu.serve import load_for_serving
+        with tempfile.TemporaryDirectory() as d:
+            train, x = self._train_and_save(d)
+            ref = train.predict(x)
+            serve = load_for_serving(d, _conv_bn_model(), search_budget=4)
+            info = serve.serve_load_info
+            assert info["mode"] == "latency-research"
+            assert info["objective"] == "latency"
+            np.testing.assert_allclose(serve.predict(x), ref, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_same_topology_reuses_saved_strategy_without_search(self):
+        from flexflow_tpu.serve import load_for_serving
+        with tempfile.TemporaryDirectory() as d:
+            train, x = self._train_and_save(d)
+            ref = train.predict(x)
+            serve = load_for_serving(d, _conv_bn_model(),
+                                     mesh=make_mesh(4, {"data": 4}),
+                                     search_budget=0)
+            assert not serve.serve_load_info["cross_mesh"]
+            np.testing.assert_allclose(serve.predict(x), ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# zoo-model Conv+BN fold + bf16 serve-predict parity (ISSUE 13 satellite)
+
+
+class TestZooFoldedPredictParity:
+    """Loaded-from-manifest predict under Conv+BN fold + bf16 compute
+    matches the training-compiled predict on two zoo models."""
+
+    def _roundtrip(self, build, x, bf16_tol):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ckpt import save_sharded
+        from flexflow_tpu.serve import load_for_serving
+        train = build()
+        train.compile(SGDOptimizer(lr=0.01),
+                      LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                      mesh=make_mesh(2, {"data": 2}))
+        # perturb BN running stats so the fold is non-trivial
+        for name, st in train.state.items():
+            if isinstance(st, dict) and "mean" in st and "var" in st:
+                st["mean"] = st["mean"] + 0.1
+                st["var"] = st["var"] * 1.5
+        ref = train.predict(x)  # f32, folded inference nodes
+        with tempfile.TemporaryDirectory() as d:
+            save_sharded(d, train)
+            # serve compile under a bf16 (TPU-policy) machine spec on a
+            # different mesh: fold + bf16 + cross-mesh in one shot
+            serve = load_for_serving(
+                d, build(), mesh=make_mesh(4, {"data": 4}),
+                search_budget=0,
+                machine_spec=MachineSpec(chip="tpu-v4", chips_per_slice=4))
+            assert serve.executor.compute_dtype == jnp.bfloat16
+            out = serve.predict(x)
+        assert np.argmax(out, -1).tolist() == np.argmax(ref, -1).tolist()
+        np.testing.assert_allclose(out, ref, atol=bf16_tol)
+
+    def test_resnet_bn(self):
+        from flexflow_tpu.models.resnet import ResNetConfig, create_resnet
+        cfg = ResNetConfig(batch_size=4, image_size=32,
+                           stages=(1, 1, 0, 0), num_classes=10,
+                           batch_norm=True)
+        x = RS.randn(4, 3, 32, 32).astype(np.float32)
+        self._roundtrip(lambda: create_resnet(cfg), x, bf16_tol=0.05)
+
+    @pytest.mark.slow
+    def test_alexnet_bn(self):
+        from flexflow_tpu.models.alexnet import create_alexnet
+        x = RS.randn(4, 3, 64, 64).astype(np.float32)
+        self._roundtrip(
+            lambda: create_alexnet(batch_size=4, num_classes=10,
+                                   image_size=64, batch_norm=True),
+            x, bf16_tol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop load generation + smoke
+
+
+class TestLoadgen:
+    def test_closed_loop_stats(self):
+        ff = _mlp()
+        engine = ff.serve(batch_buckets=(1, 4, 8), max_wait_ms=1.0,
+                          search_budget=0, start=True)
+        try:
+            from flexflow_tpu.serve.loadgen import run_closed_loop
+            samples = [RS.randn(16).astype(np.float32) for _ in range(20)]
+            stats = run_closed_loop(engine, lambda i: [samples[i % 20]],
+                                    num_requests=10, concurrency=3,
+                                    warmup=2)
+        finally:
+            engine.stop()
+        assert stats["num_measured"] == 10
+        assert not stats["errors"]
+        assert stats["p50_s"] > 0 and stats["p99_s"] >= stats["p50_s"]
+
+    def test_serve_smoke_writes_artifact(self, tmp_path):
+        from flexflow_tpu.serve.loadgen import run_serve_smoke
+        report = run_serve_smoke(trace_dir=str(tmp_path), num_requests=8)
+        path = report.get("artifact")
+        assert path and os.path.exists(path)
+        data = json.load(open(path))
+        assert data["header"]["kind"] == "serve"
+        assert data["closed_loop"]["num_measured"] == 8
