@@ -485,6 +485,30 @@ def mfu_of(ff, step_s):
         return None
 
 
+def sim_accuracy_of(name, ff, p50, sps, cfg_dict):
+    """Predicted/measured step-time ratio for one workload: the native
+    simulator's replay of the compiled strategy (learned cost table
+    engaged per the usual discovery — FFS_NO_LEARNED_COSTS opts out)
+    over the measured steady-state step. Measured = the dispatch p50
+    when the window captured one, else batch/samples-per-s. None when
+    either side is unavailable; never raises (a simulator failure must
+    not cost a bench round)."""
+    try:
+        from flexflow_tpu.search.validate import simulate_strategy
+        pred_s = simulate_strategy(ff).get("iteration_time")
+        meas_s = p50
+        if not meas_s and sps:
+            bs = cfg_dict.get("batch_size")
+            meas_s = float(bs) / sps if bs else None
+        if not (pred_s and meas_s):
+            return None
+        return round(float(pred_s) / float(meas_s), 4)
+    except Exception as e:
+        print(f"[obs] {name}: sim-accuracy replay failed: {e!r}",
+              file=sys.stderr)
+        return None
+
+
 def exposed_ratchet(hist, key, frac, tol=0.25, abs_tol=0.01):
     """Downward ratchet on the measured exposed-comms fraction (ISSUE 9:
     promoted from informational — overlap wins must not silently
@@ -712,6 +736,16 @@ def main():
             wl["step_time_p99"] = round(p99, 6)
         if mfu is not None:
             wl["mfu"] = round(mfu, 8)
+        # simulator accuracy as a tracked metric (ISSUE 14 / SCALE-Sim
+        # methodology): replay the compiled strategy through the native
+        # simulator — learned cost table engaged exactly as the search
+        # had it — and record predicted/measured step time next to
+        # throughput. Informational (no ratchet: the simulator predicts
+        # chip behavior, so a CPU round's ratio is a smoke value, and
+        # chip rounds swing with tunnel weather).
+        sim_ratio = sim_accuracy_of(name, ff, p50, sps, cfg_dict)
+        if sim_ratio is not None:
+            wl["sim_accuracy_ratio"] = sim_ratio
         # measured exposed-comms fraction from the warmup-window device
         # capture: since ISSUE 9 a downward-ratcheting GUARD (the
         # overlap direction's coordinate — a strategy/executor change
@@ -730,8 +764,13 @@ def main():
         ent = hist.get(key)
         if isinstance(ent, dict):
             ent.update({k: wl[k] for k in
-                        ("step_time_p50", "step_time_p99", "mfu")
+                        ("step_time_p50", "step_time_p99", "mfu",
+                         "sim_accuracy_ratio")
                         if k in wl})
+            if "sim_accuracy_ratio" not in wl:
+                # a failed replay must not leave a PREVIOUS round's
+                # ratio sitting next to this round's step times
+                ent.pop("sim_accuracy_ratio", None)
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",

@@ -14,6 +14,11 @@ simulator. This pass audits a searched strategy against that state:
           exactly the asymmetry that mis-ranks candidate strategies);
 * FFL703  calibration data exists but was taken on a different
           platform/device — stale for this machine.
+* FFL704  (INFO) the search priced op classes with a LEARNED cost model
+          (flexflow_tpu/costmodel) whose held-out error for that class
+          exceeds the calibration tolerance — a stale or low-coverage
+          model: its rankings for those classes deserve a fresh corpus
+          (re-trace + scripts/costmodel.py train) before being trusted.
 """
 
 from __future__ import annotations
@@ -22,7 +27,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
-from flexflow_tpu.analysis.diagnostics import Diagnostic, warning
+from flexflow_tpu.analysis.diagnostics import Diagnostic, info, warning
 
 
 def calibration_path() -> str:
@@ -62,15 +67,24 @@ class CalibrationPass:
         measured_ran = bool(ctx.config is not None
                             and getattr(ctx.config, "search_measure_ops",
                                         False))
+        learned_ran = bool(
+            isinstance(getattr(getattr(ctx, "ff", None), "search_info",
+                               None), dict)
+            and ctx.ff.search_info.get("cost_model") == "learned")
         if not all_corrections and not measured_ran:
-            diags.append(warning(
-                "FFL701",
-                "search priced every op from the analytic roofline: no "
-                "--search-measure-ops microbenchmarks and no ingested "
-                "drift corrections",
-                hint="run a traced fit (--trace-dir) then "
-                     "scripts/calibrate.py --ingest-drift TRACE_DIR to "
-                     "close the loop"))
+            if not learned_ran:
+                # learned pricing IS measurement-derived: when it
+                # engaged, the "priced purely analytically" warning is
+                # wrong — the staleness audit below applies instead
+                diags.append(warning(
+                    "FFL701",
+                    "search priced every op from the analytic roofline: "
+                    "no --search-measure-ops microbenchmarks and no "
+                    "ingested drift corrections",
+                    hint="run a traced fit (--trace-dir) then "
+                         "scripts/calibrate.py --ingest-drift TRACE_DIR "
+                         "to close the loop"))
+            diags.extend(self._learned_model_diags(ctx, cal))
             return diags
         if cal is not None and platform is not None:
             cal_platform = cal.get("platform")
@@ -103,6 +117,49 @@ class CalibrationPass:
                     f"are corrected — relative pricing is skewed",
                     hint="ingest drift from a run containing these ops "
                          "(scripts/calibrate.py --ingest-drift)"))
+        diags.extend(self._learned_model_diags(ctx, cal))
+        return diags
+
+    def _learned_model_diags(self, ctx, cal) -> List[Diagnostic]:
+        """FFL704: this strategy was priced by a learned cost model
+        whose held-out error for one of the graph's op classes exceeds
+        the calibration tolerance (stale / low-coverage model). Keyed
+        off the search's own provenance (search_info.cost_model ==
+        "learned") so the lint only fires when learned pricing actually
+        engaged, and off the COSTMODEL.json artifact's per-class
+        held-out error — the number the trainer measured, not a
+        re-derivation."""
+        search_info = getattr(getattr(ctx, "ff", None), "search_info",
+                              None)
+        if not isinstance(search_info, dict) \
+                or search_info.get("cost_model") != "learned":
+            return []
+        try:
+            from flexflow_tpu.costmodel import load_model
+            model = load_model()
+        except Exception:
+            return []
+        if model is None:
+            return []
+        tolerance = float((cal or {}).get("tolerance", 0.25))
+        graph_types = {n.op.op_type.name for n in ctx.nodes
+                       if n.op.flops() > 0}
+        diags: List[Diagnostic] = []
+        for cname in sorted(graph_types & set(model.classes)):
+            cm = model.classes[cname]
+            if cm.err_factor - 1.0 <= tolerance:
+                continue
+            diags.append(info(
+                "FFL704",
+                f"search priced {cname} with a learned cost model whose "
+                f"held-out error is x{cm.err_factor:.2f} "
+                f"(> {1 + tolerance:.2f}x calibration tolerance; "
+                f"{cm.n_train} training rows, {cm.n_test} held out) — "
+                f"stale or low-coverage model for this class",
+                hint="collect more traces for this op class (traced "
+                     "fits with --search-measure-ops, or "
+                     "scripts/roofline.py) and re-run "
+                     "scripts/costmodel.py train"))
         return diags
 
 

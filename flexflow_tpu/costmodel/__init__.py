@@ -1,0 +1,56 @@
+"""Learned TPU cost model: measure -> learn -> search, closed.
+
+The simulator's per-op compute pricing was a pile of hand-tuned
+heuristics (the flat ``mxu_efficiency`` asymptote, the
+``conv_efficiency`` conv-class scalar, the additive ``min_op_time``
+dispatch floor) that CALIBRATION.json showed missing badly where
+coverage is thin. Following "A Learned Performance Model for Tensor
+Processing Units" (PAPERS.md, arXiv 2008.01040), this package trains a
+small per-op-class regression on the accumulated measurement corpus —
+the ``*.simtrace.json`` rows (op class x shape x sharding choice ->
+priced terms -> measured seconds) the obs subsystem has been emitting
+since PR 7, joined with roofline and devtrace-drift measurements — and
+hands the trained table to the native search, which queries it where
+coverage exists and falls back to the analytic terms elsewhere.
+
+Layers:
+
+- ``corpus``: trace-dir ingestion -> deduplicated, schema-versioned
+  training corpus (``COSTMODEL_CORPUS.json``) + the featurization the
+  native evaluator mirrors bit-for-bit.
+- ``model``: numpy ridge regression in log space per op class, with a
+  per-class feature hull; serialized to ``COSTMODEL.json`` with
+  coverage counts and held-out error. ``predict`` returns
+  ``(seconds, confidence)`` — low confidence outside the hull.
+- discovery: ``load_native_table`` locates the trained model
+  (``FFS_COSTMODEL_FILE`` or the repo-root ``COSTMODEL.json``), gates
+  it per platform, and exports the native-evaluable coefficient table
+  ``machine_to_json`` threads into ``libffsearch.so``.
+  ``FFS_NO_LEARNED_COSTS=1`` opts out entirely (searches are then
+  bit-identical to the pre-costmodel behavior).
+
+Validation (SCALE-Sim TPU methodology, arXiv 2603.22535): simulator
+accuracy is a *tracked metric* — ``scripts/costmodel.py report`` and
+``scripts/obs_report.py`` render predicted-vs-measured step time
+analytic-vs-learned side by side, ``bench.py`` records
+``sim_accuracy_ratio`` per workload, and ``scripts/explain.py`` shows
+when the two models rank a different winner for an op.
+"""
+
+from flexflow_tpu.costmodel.corpus import (CORPUS_SCHEMA_VERSION,
+                                           FEATURE_NAMES, CorpusSchemaError,
+                                           build_corpus, featurize,
+                                           load_corpus, load_trace_dir,
+                                           save_corpus)
+from flexflow_tpu.costmodel.model import (MIN_CLASS_ROWS,
+                                          MODEL_SCHEMA_VERSION, CostModel,
+                                          default_model_path,
+                                          load_model, load_native_table,
+                                          train_model)
+
+__all__ = [
+    "CORPUS_SCHEMA_VERSION", "FEATURE_NAMES", "CorpusSchemaError",
+    "build_corpus", "featurize", "load_corpus", "load_trace_dir",
+    "save_corpus", "MIN_CLASS_ROWS", "MODEL_SCHEMA_VERSION", "CostModel",
+    "default_model_path", "load_model", "load_native_table", "train_model",
+]

@@ -23,6 +23,7 @@ PAPERS.md 2008.01040).
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Optional
 
 # Perfetto lane tids for the predicted schedule, disjoint from the
@@ -36,6 +37,14 @@ SIM_LANE_THREADS = {SIM_TID_COMPUTE: "sim:compute",
 # comm/gradsync ride the ICI stream, everything else the compute
 # stream). Public: explain.py's timeline rendering uses the same map.
 SIM_COMMS_KINDS = ("comm", "gradsync")
+
+# Corpus-row schema version of the ``per_op`` rows below. v2 added the
+# featurization fields the learned cost model trains on (flops,
+# io_bytes, param_bytes, dtype_size, mesh degrees, ring sizes) — the
+# costmodel corpus loader (flexflow_tpu/costmodel/corpus.py) refuses
+# rows NEWER than what it understands, so a schema drift here fails the
+# CI costmodel stage loudly instead of silently training on garbage.
+CORPUS_SCHEMA_VERSION = 2
 
 
 def sim_lane_events(tasks: List[Dict[str, Any]],
@@ -119,6 +128,12 @@ def corpus_rows(ff, resp: Dict[str, Any],
 
     measured = measured if measured is not None else (ff.op_profile or {})
     priced = per_op_predicted(resp.get("tasks") or [])
+    # which model priced each node's compute (analytic roofline vs
+    # learned regression vs measured profile) — ffs_simulate reports it
+    # per guid when the machine carried a learned table
+    sources = resp.get("cost_sources") or {}
+    mesh_axes = dict(zip(ff.mesh.axis_names,
+                         (int(d) for d in ff.mesh.devices.shape)))
     rows: List[Dict[str, Any]] = []
     for idx, node in enumerate(ff.executor.nodes):
         op = node.op
@@ -127,7 +142,17 @@ def corpus_rows(ff, resp: Dict[str, Any],
                                  gradsync_s=0.0, collective_bytes=0.0))
         mf = measured.get(f"{op.guid}:fwd")
         mb = measured.get(f"{op.guid}:bwd")
+        dts = op.dtype.size
+        # native total_io_bytes convention (ffs_graph.hpp): params +
+        # every input + every output at the op's dtype width — the
+        # byte half of the learned model's featurization
+        io_bytes = float(op.params_elems()) * dts
+        for s in op.input_shapes:
+            io_bytes += float(math.prod(s)) * dts
+        for s in op.output_shapes:
+            io_bytes += float(math.prod(s)) * dts
         rows.append(dict(
+            schema=CORPUS_SCHEMA_VERSION,
             guid=op.guid,
             name=op.name,
             type=op.op_type.name,
@@ -138,7 +163,15 @@ def corpus_rows(ff, resp: Dict[str, Any],
             # work_div is the strategy's split so consumers can compare
             # measured/work_div against priced fwd+bwd (compute only)
             work_div=work_division(node, ff.mesh),
-            priced=dict(p),
+            # featurization fields (op class x shape x choice x mesh):
+            # whole-op analytic FLOPs/bytes; the trainer shards them by
+            # work_div to match the per-chip pricing the DP queries
+            flops=float(op.flops()),
+            io_bytes=io_bytes,
+            param_bytes=float(op.params_elems()) * dts,
+            dtype_size=dts,
+            mesh_axes=mesh_axes,
+            priced=dict(p, source=sources.get(str(op.guid), "analytic")),
             measured=dict(
                 fwd_s=mf, bwd_s=mb,
                 source="measured" if mf is not None else None),
@@ -147,11 +180,24 @@ def corpus_rows(ff, resp: Dict[str, Any],
 
 
 def simtrace_report(ff, resp: Dict[str, Any],
-                    measured: Optional[Dict[str, float]] = None
+                    measured: Optional[Dict[str, float]] = None,
+                    resp_analytic: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Any]:
     """The ``.simtrace.json`` payload: predicted step breakdown + the
-    per-op corpus rows + the mesh the prediction assumed."""
-    return dict(
+    per-op corpus rows + the mesh the prediction assumed.
+
+    ``resp_analytic``: a second simulation of the same strategy with the
+    learned cost table disabled — when the active prediction used
+    learned per-op costs, the analytic twin rides along so the obs
+    report can show simulator accuracy analytic-vs-learned side by side
+    (the SCALE-Sim-style tracked metric)."""
+    rows = corpus_rows(ff, resp, measured=measured)
+    src_census: Dict[str, int] = {}
+    for r in rows:
+        s = (r.get("priced") or {}).get("source") or "analytic"
+        src_census[s] = src_census.get(s, 0) + 1
+    report = dict(
+        corpus_schema=CORPUS_SCHEMA_VERSION,
         predicted=dict(
             step_s=resp.get("iteration_time"),
             fwd_s=resp.get("fwd_time"),
@@ -171,8 +217,21 @@ def simtrace_report(ff, resp: Dict[str, Any],
         tasks=sum(1 for t in (resp.get("tasks") or [])
                   if float(t.get("finish", 0.0))
                   > float(t.get("start", 0.0))),
-        per_op=corpus_rows(ff, resp, measured=measured),
+        # which model priced the compute terms, per op (the learned
+        # cost model's engagement census: all-analytic when no trained
+        # table is loaded / FFS_NO_LEARNED_COSTS is set)
+        cost_sources=src_census,
+        per_op=rows,
     )
+    if resp_analytic is not None:
+        report["predicted_analytic"] = dict(
+            step_s=resp_analytic.get("iteration_time"),
+            fwd_s=resp_analytic.get("fwd_time"),
+            bwd_s=resp_analytic.get("bwd_time"),
+            comm_s=resp_analytic.get("comm_time"),
+            gradsync_s=resp_analytic.get("gradsync_time"),
+        )
+    return report
 
 
 def write_simtrace(ff, tracer, align_ts_us: Optional[float] = None
@@ -194,7 +253,16 @@ def write_simtrace(ff, tracer, align_ts_us: Optional[float] = None
     import os
 
     resp = simulate_strategy(ff)
-    report = simtrace_report(ff, resp)
+    resp_analytic = None
+    if any(v == "learned" for v in (resp.get("cost_sources") or {}).values()):
+        # the prediction used learned per-op costs: simulate the same
+        # strategy once more with the table disabled so the artifact
+        # carries analytic-vs-learned accuracy side by side
+        try:
+            resp_analytic = simulate_strategy(ff, learned=False)
+        except Exception:
+            resp_analytic = None
+    report = simtrace_report(ff, resp, resp_analytic=resp_analytic)
     if align_ts_us is None:
         align_ts_us = tracer.last_step_start_us() or 0.0
     name_of = {i: n.op.name for i, n in enumerate(ff.executor.nodes)}

@@ -118,13 +118,20 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
 
 
 def machine_to_json(spec, num_devices: int,
-                    comm_bytes_factor: float = 1.0) -> Dict[str, Any]:
+                    comm_bytes_factor: float = 1.0,
+                    learned: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """``learned``: the trained cost-model coefficient table
+    (flexflow_tpu/costmodel ``native_table()``) the native evaluator
+    prices covered op classes with; None (the default and the
+    FFS_NO_LEARNED_COSTS state) keeps pure analytic pricing —
+    bit-identical to pre-costmodel behavior."""
     # arbitrary inter-slice fabrics reduce to the ring's bottleneck
     # (bandwidth, routed latency) — MachineSpec.effective_dcn
     dcn_bw, dcn_latency = (spec.effective_dcn()
                            if hasattr(spec, "effective_dcn")
                            else (spec.dcn_bw, spec.dcn_latency))
-    return dict(
+    out = dict(
         num_devices=num_devices,
         flops=spec.flops,
         hbm_bw=spec.hbm_bw,
@@ -152,6 +159,9 @@ def machine_to_json(spec, num_devices: int,
         # per-axis ring pricing (ffs_machine.hpp assign_torus)
         torus=[int(t) for t in getattr(spec, "torus", None) or []],
     )
+    if learned:
+        out["learned"] = learned
+    return out
 
 
 def _entries_to_spec(entries: List[Optional[Any]]) -> P:
@@ -268,10 +278,27 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
     # master-weight regime; CPU/f32 machines keep 1.0)
     comm_factor = 0.5 if (getattr(config, "allow_mixed_precision", True)
                           and machine_spec.chip != "cpu-sim") else 1.0
+    # learned per-op-class cost table (flexflow_tpu/costmodel): trained
+    # COSTMODEL.json coefficients the DP queries where coverage exists,
+    # analytic fallback elsewhere. None (no trained model, platform
+    # mismatch, or FFS_NO_LEARNED_COSTS) keeps pre-costmodel pricing.
+    try:
+        from flexflow_tpu.costmodel import load_native_table
+        learned = load_native_table()
+    except Exception:
+        learned = None
+    # provenance is about THIS graph, not the table: a model whose
+    # classes never intersect the graph's op types prices nothing here
+    # (everything stays analytic), and claiming "learned" would both
+    # misreport and suppress fflint's all-analytic FFL701 warning
+    learned_classes = sorted(
+        set((learned or {}).get("classes") or ())
+        & {n.op.op_type.name for n in nodes})
     request = dict(
         nodes=serialize_graph(nodes),
         machine=machine_to_json(machine_spec, num_devices,
-                                comm_bytes_factor=comm_factor),
+                                comm_bytes_factor=comm_factor,
+                                learned=learned),
         config=dict(
             budget=config.search_budget,
             alpha=config.search_alpha,
@@ -377,8 +404,15 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
                 predicted_memory=resp.get("predicted_memory"),
                 memory_correction=mem_correction,
                 objective=objective,
+                # cost-model provenance: which pricing regime the search
+                # ran under, and (when learned) which of this GRAPH's op
+                # classes the trained table covered — fflint's staleness
+                # lint and the strategy artifacts read this
+                cost_model="learned" if learned_classes else "analytic",
                 stats=resp.get("stats", {}),
                 rewrites=resp.get("rewrites", []))
+    if learned_classes:
+        info["learned_cost_classes"] = learned_classes
     if resp.get("search_trace"):
         trace = dict(resp["search_trace"])
         trace.setdefault("objective", objective)

@@ -122,15 +122,30 @@ def predicted_vs_actual_memory(ff) -> Dict[str, float]:
                 ratio=actual / float(predicted))
 
 
-def simulate_strategy(ff) -> Dict[str, Any]:
+def simulate_strategy(ff, learned: Any = "auto") -> Dict[str, Any]:
     """Replay the strategy FFModel.compile selected through the native
     simulator; returns the FULL response — iteration_time / memory /
     fwd/bwd/comm/gradsync breakdown plus the scheduled task list
     (per-task start/finish seconds and collective census records). The
     task schedule is what ``obs/simtrace.py`` renders as the predicted
-    Perfetto timeline next to the measured device lanes."""
+    Perfetto timeline next to the measured device lanes.
+
+    ``learned``: "auto" (default) prices with the same discovered
+    learned cost table the search used (so replayed predictions match
+    searched ones); False forces pure analytic pricing (the
+    analytic-vs-learned accuracy comparison's control arm); an explicit
+    native-table dict uses that table."""
     from flexflow_tpu.search.native import native_simulate
     from flexflow_tpu.search.unity import machine_to_json, serialize_graph
+
+    if learned == "auto":
+        try:
+            from flexflow_tpu.costmodel import load_native_table
+            learned = load_native_table()
+        except Exception:
+            learned = None
+    elif not learned:
+        learned = None
 
     nodes = ff.executor.nodes
     wus_on = bool(getattr(ff.executor, "weight_update_sharding", False))
@@ -163,7 +178,8 @@ def simulate_strategy(ff) -> Dict[str, Any]:
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
     req = dict(
         nodes=serialize_graph(nodes),
-        machine=machine_to_json(ff.machine_spec, ff.mesh.devices.size),
+        machine=machine_to_json(ff.machine_spec, ff.mesh.devices.size,
+                                learned=learned),
         config=dict(training=True, overlap=True,
                     opt_state_factor=getattr(ff.config, "opt_state_factor",
                                              2.0)),

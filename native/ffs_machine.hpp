@@ -24,6 +24,36 @@ namespace ffsearch {
 // Spec axis constants in ffs_strategy.hpp (kData..kExpert).
 enum : int8_t { AX_DATA = 0, AX_MODEL = 1, AX_SEQ = 2, AX_EXPERT = 3 };
 
+// ---- learned cost model (flexflow_tpu/costmodel) ---------------------------
+//
+// Per-op-class ridge regression over log-space features, trained by
+// scripts/costmodel.py on the simtrace measurement corpus ("A Learned
+// Performance Model for TPUs", PAPERS.md 2008.01040) and shipped to the
+// search inside the machine JSON ("learned" key, machine_to_json). The
+// feature vector MUST mirror flexflow_tpu/costmodel/corpus.py featurize()
+// exactly — same order, same transforms — or the coefficients price a
+// different space than they were trained in:
+//   f0 = log1p(fwd_flops / work_div)
+//   f1 = log1p(total_io_bytes / work_div)
+//   f2 = log1p(param_bytes)
+//   f3 = log(work_div)
+constexpr int kLearnedFeatures = 4;
+
+struct LearnedClass {
+  std::vector<double> wf, wb;      // [intercept, w0..w3] fwd / bwd
+  std::vector<double> fmin, fmax;  // training feature hull
+  double err = 0;                  // held-out median |log(pred/actual)|
+  int64_t n = 0;                   // training rows (coverage)
+};
+
+// Which model priced a node's compute terms (NodeCost.src /
+// search-trace "cost_source": the per-candidate provenance column).
+enum : int8_t { SRC_ANALYTIC = 0, SRC_LEARNED = 1, SRC_MEASURED = 2 };
+inline const char* cost_source_name(int8_t s) {
+  return s == SRC_LEARNED ? "learned"
+       : s == SRC_MEASURED ? "measured" : "analytic";
+}
+
 struct MachineModel {
   int num_devices = 1;
   double flops = 197e12;       // bf16 peak FLOP/s per chip
@@ -62,6 +92,42 @@ struct MachineModel {
   // machine-model link graphs (simulator.h:229-515) with the structure
   // TPU hardware actually has. Empty = flat (every axis prices alike).
   std::vector<int64_t> torus;
+
+  // Learned per-op-class compute pricing (empty = analytic only; the
+  // Python side omits the table under FFS_NO_LEARNED_COSTS or when no
+  // trained COSTMODEL.json exists, so absence == pre-costmodel
+  // behavior bit-for-bit). Class absent from the map = coverage gate:
+  // that class keeps the analytic roofline.
+  std::map<std::string, LearnedClass> learned;
+  double learned_hull_margin = 0.7;
+
+  // Learned per-chip (fwd, bwd) seconds for `type` at feature vector
+  // `f` — false when the class is untrained or `f` falls outside the
+  // trained hull (plus margin): extrapolation falls back to analytic.
+  bool learned_predict(const std::string& type,
+                       const double (&f)[kLearnedFeatures],
+                       double* fwd, double* bwd) const {
+    auto it = learned.find(type);
+    if (it == learned.end()) return false;
+    const LearnedClass& lc = it->second;
+    if (lc.wf.size() != kLearnedFeatures + 1 ||
+        lc.wb.size() != kLearnedFeatures + 1 ||
+        lc.fmin.size() != kLearnedFeatures ||
+        lc.fmax.size() != kLearnedFeatures)
+      return false;
+    for (int i = 0; i < kLearnedFeatures; ++i)
+      if (f[i] < lc.fmin[i] - learned_hull_margin ||
+          f[i] > lc.fmax[i] + learned_hull_margin)
+        return false;
+    double lf = lc.wf[0], lb = lc.wb[0];
+    for (int i = 0; i < kLearnedFeatures; ++i) {
+      lf += lc.wf[i + 1] * f[i];
+      lb += lc.wb[i + 1] * f[i];
+    }
+    *fwd = std::exp(lf);
+    *bwd = std::exp(lb);
+    return true;
+  }
   // Per-logical-axis multipliers from embedding the CURRENT mesh into
   // the torus (assign_torus): a mesh axis mapped to a full torus dim
   // keeps the wrapped-ring bandwidth (1.0); a sub-ring of a dim is a
@@ -164,6 +230,24 @@ struct MachineModel {
     const Json& tj = j.get("torus");
     if (!tj.is_null())
       for (const Json& t : tj.items()) m.torus.push_back(t.as_int(1));
+    const Json& lj = j.get("learned");
+    if (!lj.is_null()) {
+      m.learned_hull_margin =
+          lj.get("hull_margin").as_double(m.learned_hull_margin);
+      for (const auto& kv : lj.get("classes").fields()) {
+        LearnedClass lc;
+        auto fill = [](const Json& arr, std::vector<double>& out) {
+          for (const Json& v : arr.items()) out.push_back(v.as_double());
+        };
+        fill(kv.second.get("wf"), lc.wf);
+        fill(kv.second.get("wb"), lc.wb);
+        fill(kv.second.get("fmin"), lc.fmin);
+        fill(kv.second.get("fmax"), lc.fmax);
+        lc.err = kv.second.get("err").as_double(0);
+        lc.n = kv.second.get("n").as_int(0);
+        m.learned[kv.first] = std::move(lc);
+      }
+    }
     return m;
   }
 

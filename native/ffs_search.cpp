@@ -775,9 +775,13 @@ Json choice_collectives_json(const Choice& c, bool training) {
 // DP sees them. compute = fwd+bwd roofline; collective = per-op comms +
 // gradient sync; opt_state = the update-triad HBM time WUS divides by the
 // ring; memory = param / opt-state / activation bytes per device.
+// `analytic_m` (optional): the machine with the learned table cleared,
+// hoisted to the caller — it is invariant across the whole candidate
+// loop and a per-candidate MachineModel copy would churn allocations.
 Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
                        const MachineModel& m, const SearchConfig& cfg,
-                       const MeasuredCosts* measured, bool chosen) {
+                       const MeasuredCosts* measured, bool chosen,
+                       const MachineModel* analytic_m = nullptr) {
   NodeCost full = node_cost(n, c, mesh, m, cfg.training, measured,
                             cfg.opt_state_factor);
   NodeCost base = node_cost(n, c, mesh, m, cfg.training, measured);
@@ -788,10 +792,33 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
   cj.set("choice", Json(c.name));
   cj.set("chosen", Json(chosen));
   cj.set("work_div", Json(c.work_div));
+  // which model priced this candidate's compute (learned vs analytic
+  // vs measured) — the per-candidate provenance the costmodel loop
+  // audits (ISSUE 14)
+  cj.set("cost_source", Json(std::string(cost_source_name(base.src))));
   Json terms = Json::object();
   terms.set("fwd_s", Json(base.fwd));
   terms.set("bwd_s", Json(base.bwd));
   terms.set("compute_s", Json(base.fwd + base.bwd));
+  if (!m.learned.empty() && analytic_m != nullptr &&
+      base.src != SRC_MEASURED) {
+    // learned-vs-analytic side by side: reprice the compute under the
+    // analytic roofline alone (NO measured table — a measured override
+    // here would label profile seconds "analytic" and fabricate
+    // disagreements), and under the learned table when this (class,
+    // features) is covered — explain.py's disagreement table flags ops
+    // where the two models rank a different winner. Measured-priced
+    // candidates skip the columns entirely: the DP used neither model.
+    NodeCost an = node_cost(n, c, mesh, *analytic_m, cfg.training,
+                            nullptr);
+    terms.set("compute_analytic_s", Json(an.fwd + an.bwd));
+    double lf = 0, lb = 0;
+    if (learned_compute(n, c, m, &lf, &lb)) {
+      double tf = std::max(lf, m.min_op_time);
+      double tb = cfg.training ? std::max(lb, m.min_op_time) : 0.0;
+      terms.set("compute_learned_s", Json(tf + tb));
+    }
+  }
   terms.set("comm_s", Json(base.comm));
   terms.set("gradsync_s", Json(base.gradsync));
   terms.set("collective_s", Json(base.comm + base.gradsync));
@@ -861,6 +888,13 @@ Json per_op_trace(const Graph& g,
                   const MachineModel& m, const SearchConfig& cfg,
                   const MeasuredCosts* measured) {
   Json ops = Json::array();
+  MachineModel analytic;
+  const MachineModel* analytic_m = nullptr;
+  if (!m.learned.empty()) {
+    analytic = m;
+    analytic.learned.clear();
+    analytic_m = &analytic;
+  }
   for (size_t i = 0; i < g.nodes.size(); ++i) {
     const Node& n = g.nodes[i];
     Json oj = Json::object();
@@ -877,7 +911,8 @@ Json per_op_trace(const Graph& g,
     Json cands = Json::array();
     for (size_t ci = 0; ci < choices[i].size(); ++ci)
       cands.push_back(choice_trace_json(n, choices[i][ci], mesh, m, cfg,
-                                        measured, ci == (size_t)assign[i]));
+                                        measured, ci == (size_t)assign[i],
+                                        analytic_m));
     oj.set("candidates", cands);
     ops.push_back(std::move(oj));
   }
@@ -1428,6 +1463,19 @@ Json simulate_only(const Json& req) {
   out.set("bwd_time", Json(r.bwd_time));
   out.set("comm_time", Json(r.comm_time));
   out.set("gradsync_time", Json(r.gradsync_time));
+  // per-node compute pricing provenance (guid -> analytic | learned |
+  // measured): the simtrace corpus rows record which model priced each
+  // op so accuracy tracking can attribute drift to the right source
+  {
+    Json srcs = Json::object();
+    for (size_t i = 0; i < g.nodes.size(); ++i) {
+      NodeCost nc = node_cost(g.nodes[i], cs[i], mesh, m, cfg.training,
+                              &measured);
+      srcs.set(std::to_string(g.nodes[i].guid),
+               Json(std::string(cost_source_name(nc.src))));
+    }
+    out.set("cost_sources", srcs);
+  }
   // predicted comm seconds hidden under compute (the schedule's
   // overlapped intervals + the pipeline/"_ovl" analytic hidden terms) —
   // the predicted twin of devtrace's measured overlapped_comms_s

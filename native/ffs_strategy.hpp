@@ -782,8 +782,38 @@ struct NodeCost {
   // follows (byte-weighted across the winning assignment)
   double ovl_bucket_mb = 0;
   int ovl_buckets = 0;
+  // which model priced fwd/bwd (SRC_ANALYTIC / SRC_LEARNED /
+  // SRC_MEASURED) — recorded per candidate in the search trace and per
+  // node in the simulate response so every priced number is traceable
+  // to its source
+  int8_t src = SRC_ANALYTIC;
   double total() const { return fwd + bwd + comm + gradsync; }
 };
+
+// The learned model's feature vector for (node, choice) — MUST mirror
+// flexflow_tpu/costmodel/corpus.py featurize() (see ffs_machine.hpp).
+inline void learned_features(const Node& n, const Choice& c,
+                             double (&f)[kLearnedFeatures]) {
+  double div = std::max(1.0, c.work_div);
+  f[0] = std::log1p(n.fwd_flops / div);
+  f[1] = std::log1p((double)n.total_io_bytes() / div);
+  f[2] = std::log1p((double)n.param_bytes());
+  f[3] = std::log(div);
+}
+
+// Learned per-chip (fwd, bwd) compute seconds for (node, choice):
+// false when no table is loaded, the op class is below the coverage
+// gate (absent from the table), or the query falls outside the trained
+// feature hull — callers then keep the analytic roofline. Shared by
+// node_cost and the search trace's learned-vs-analytic columns.
+inline bool learned_compute(const Node& n, const Choice& c,
+                            const MachineModel& m, double* fwd,
+                            double* bwd) {
+  if (m.learned.empty()) return false;
+  double f[kLearnedFeatures];
+  learned_features(n, c, f);
+  return m.learned_predict(n.type, f, fwd, bwd);
+}
 
 // Layout-only ops XLA fuses into their producer/consumer on TPU: a slice,
 // concat or reshape of a matmul output compiles to index arithmetic inside
@@ -871,11 +901,30 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
     else if (n.type == "CONV2D")
       eff = m.conv_efficiency;  // geometry unavailable: flat conv class
   }
-  nc.fwd = mfwd ? std::max(*mfwd / div, m.min_op_time)
-                : m.compute_time(flop, bytes, n.dtype_size, eff);
-  if (training)
-    nc.bwd = mbwd ? std::max(*mbwd / div, m.min_op_time)
-                  : 2.0 * nc.fwd;  // dX + dW passes
+  // pricing priority: measured per-op profile > learned regression >
+  // analytic roofline. The learned model predicts per-chip SHARDED
+  // seconds directly (its targets were measured/work_div and work_div
+  // is a feature), so no further division applies.
+  double lfwd = 0, lbwd = 0;
+  bool has_learned =
+      mfwd == nullptr && learned_compute(n, c, m, &lfwd, &lbwd);
+  if (mfwd != nullptr) {
+    nc.fwd = std::max(*mfwd / div, m.min_op_time);
+    nc.src = SRC_MEASURED;
+  } else if (has_learned) {
+    nc.fwd = std::max(lfwd, m.min_op_time);
+    nc.src = SRC_LEARNED;
+  } else {
+    nc.fwd = m.compute_time(flop, bytes, n.dtype_size, eff);
+  }
+  if (training) {
+    if (mbwd != nullptr)
+      nc.bwd = std::max(*mbwd / div, m.min_op_time);
+    else if (has_learned)
+      nc.bwd = std::max(lbwd, m.min_op_time);
+    else
+      nc.bwd = 2.0 * nc.fwd;  // dX + dW passes
+  }
   if (c.psum_bytes > 0 && c.psum_k > 1) {
     double t = m.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective

@@ -115,6 +115,56 @@ def chosen_vs_runner_up(trace, top=20):
     return rows[:top], len(rows)
 
 
+def learned_vs_analytic_disagreements(trace):
+    """Ops where the learned and the analytic cost model rank a
+    DIFFERENT winning choice (ISSUE 14: the disagreement is exactly
+    where retiring a heuristic changes a search decision, so it must be
+    reviewable). Uses the search trace's per-candidate side-by-side
+    columns: each candidate's total is re-read with its compute term
+    swapped to the analytic / learned pricing; the learned ranking uses
+    learned compute where the class+hull covers the candidate and
+    analytic elsewhere — the exact blend the DP prices. Returns
+    (rows, n_ops_compared); empty when no learned table was active."""
+    rows = []
+    compared = 0
+    for op in trace.get("ops") or []:
+        cands = op.get("candidates") or []
+        if not cands or "compute_analytic_s" not in cands[0].get("terms", {}):
+            continue  # no learned table was loaded for this search
+
+        def total_with(c, compute_s):
+            t = c["terms"]
+            return t["total_s"] - t["compute_s"] + compute_s
+
+        an, le = [], []
+        for c in cands:
+            t = c["terms"]
+            a = t.get("compute_analytic_s")
+            if a is None:
+                an = []
+                break
+            an.append((total_with(c, a), c))
+            le.append((total_with(c, t.get("compute_learned_s", a)), c))
+        if not an:
+            continue
+        compared += 1
+        win_an = min(an, key=lambda x: x[0])
+        win_le = min(le, key=lambda x: x[0])
+        if win_an[1]["choice"] == win_le[1]["choice"]:
+            continue
+        rows.append(dict(
+            name=op.get("name"), type=op.get("type"),
+            chosen=op.get("chosen"),
+            learned_winner=win_le[1]["choice"],
+            learned_s=win_le[0],
+            analytic_winner=win_an[1]["choice"],
+            analytic_s=win_an[0],
+            cost_source=win_le[1].get("cost_source"),
+        ))
+    rows.sort(key=lambda r: -(r.get("learned_s") or 0.0))
+    return rows, compared
+
+
 def mesh_summary(trace):
     """(ranked feasible meshes, illegal-reason histogram)."""
     feasible, reasons = [], {}
@@ -196,7 +246,8 @@ def write_sim_trace_file(trace_dir, model, sim_resp, name_of):
 
 
 def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
-                reasons, path_rows, path_total, merged_path):
+                reasons, path_rows, path_total, merged_path,
+                disagreements=None, n_compared=0):
     info = ff.search_info if isinstance(ff.search_info, dict) else {}
     stats = info.get("stats") or {}
     mesh = trace.get("winner_mesh") or {}
@@ -262,6 +313,32 @@ def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
             f"{_fmt_s(r.get('runner_up_s'), 4)} | "
             f"{'-' if delta is None else f'{delta:+.1%}'} | "
             f"{' '.join(r['collectives']) or '-'} |")
+    if n_compared:
+        lines += ["", "## Learned vs analytic cost model", ""]
+        if disagreements:
+            lines += [
+                f"The two models rank a DIFFERENT winner for "
+                f"{len(disagreements)} of {n_compared} ops — exactly "
+                f"where the learned table changes a search decision "
+                f"(per-candidate compute swapped between pricings, "
+                f"comms terms held fixed):",
+                "",
+                "| op | type | chosen | learned winner | ms | "
+                "analytic winner | ms |",
+                "|---|---|---|---|---|---|---|",
+            ]
+            for d in disagreements:
+                lines.append(
+                    f"| {d['name']} | {d['type']} | {d['chosen']} | "
+                    f"{d['learned_winner']} | {_fmt_s(d['learned_s'], 4)} "
+                    f"| {d['analytic_winner']} | "
+                    f"{_fmt_s(d['analytic_s'], 4)} |")
+        else:
+            lines.append(
+                f"A learned cost table was active ({n_compared} ops "
+                f"compared) and both models rank the same winner "
+                f"everywhere — the learned model refines magnitudes "
+                f"without flipping any choice on this graph.")
     lines += [
         "",
         f"## Simulated timeline path (first {len(path_rows)} of "
@@ -319,7 +396,14 @@ def main():
     ap.add_argument("--measure-ops", action="store_true",
                     help="microbenchmark ops so corpus rows carry "
                          "measured seconds")
+    ap.add_argument("--costmodel", default=None,
+                    help="trained COSTMODEL.json to price the search "
+                         "with (sets FFS_COSTMODEL_FILE; default: the "
+                         "usual discovery — repo-root COSTMODEL.json "
+                         "if one exists)")
     args = ap.parse_args()
+    if args.costmodel:
+        os.environ["FFS_COSTMODEL_FILE"] = args.costmodel
 
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.search.validate import simulate_strategy
@@ -361,22 +445,28 @@ def main():
 
     from flexflow_tpu.obs.artifacts import write_artifact
     from flexflow_tpu.obs.simtrace import corpus_rows
+    disagreements, n_compared = learned_vs_analytic_disagreements(trace)
     out_json = os.path.join(args.out_dir, "SEARCH_TRACE.json")
-    write_artifact(out_json, dict(
+    artifact = dict(
         model=args.model,
         search_trace=trace,
         corpus=corpus_rows(ff, sim_resp, measured=measured),
         predicted=dict(step_s=sim_resp.get("iteration_time"),
                        memory_bytes=sim_resp.get("memory")),
         merged_trace=merged_path,
-    ), kind="search_trace")
+    )
+    if n_compared:
+        artifact["cost_model_disagreements"] = dict(
+            ops_compared=n_compared, rows=disagreements)
+    write_artifact(out_json, artifact, kind="search_trace")
 
     rows, total_ops = chosen_vs_runner_up(trace, top=args.top)
     feasible, reasons = mesh_summary(trace)
     path_rows, path_total = timeline_path(sim_resp, name_of)
     md = to_markdown(args.model, ff, trace, sim_resp, rows, total_ops,
                      feasible, reasons, path_rows, path_total,
-                     merged_path)
+                     merged_path, disagreements=disagreements,
+                     n_compared=n_compared)
     out_md = os.path.join(args.out_dir, "EXPLAIN.md")
     with open(out_md, "w") as f:
         f.write(md)

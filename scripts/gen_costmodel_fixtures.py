@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Regenerate the committed learned-cost-model fixture corpus.
+
+Writes real ``*.simtrace.json`` artifacts (corpus schema v2: per-op
+identity + featurization fields + MEASURED per-op seconds from
+standalone microbenchmarks) for a family of tiny CPU-sized models into
+``tests/fixtures/costmodel/`` — the corpus ``scripts/costmodel.py
+train`` runs on in the tier-1 costmodel stage and in
+``tests/test_costmodel.py``. Shape/width/batch diversity across the
+family is what gives each op class a non-degenerate feature range to
+regress over.
+
+Usage: JAX_PLATFORMS=cpu python scripts/gen_costmodel_fixtures.py [DIR]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# search needs >1 device to produce sharded choices/work divisions
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+
+def build_family():
+    """(stem, builder) pairs — tiny, diverse shapes per op class."""
+    from flexflow_tpu.models.alexnet import create_alexnet
+    from flexflow_tpu.models.mlp import create_mlp
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 create_transformer)
+
+    def mlp(batch, in_dim, dims):
+        def b(cfg):
+            return create_mlp(batch_size=batch, in_dim=in_dim,
+                              hidden_dims=dims, out_dim=10,
+                              ff_config=cfg), "cat"
+        return b
+
+    def alexnet(batch):
+        def b(cfg):
+            return create_alexnet(batch_size=batch, num_classes=10,
+                                  ff_config=cfg), "cat"
+        return b
+
+    def transformer(batch, hidden, heads, seq, layers=2):
+        def b(cfg):
+            return create_transformer(
+                TransformerConfig(num_layers=layers, hidden_size=hidden,
+                                  num_heads=heads, seq_length=seq,
+                                  batch_size=batch), cfg), "mse"
+        return b
+
+    return [
+        ("mlp_b16", mlp(16, 64, (128, 128))),
+        ("mlp_b32", mlp(32, 128, (256, 64))),
+        ("mlp_b8", mlp(8, 256, (64, 32, 128))),
+        ("alexnet_b8", alexnet(8)),
+        ("alexnet_b4", alexnet(4)),
+        ("transformer_b16", transformer(16, 128, 4, 64)),
+        ("transformer_b8", transformer(8, 64, 2, 32)),
+        # attention-coverage sweep: distinct (hidden, heads, seq, batch)
+        # tuples so MULTIHEAD_ATTENTION clears the class coverage gate
+        ("transformer_b4s16", transformer(4, 32, 2, 16, layers=1)),
+        ("transformer_b32s16", transformer(32, 64, 4, 16, layers=1)),
+        ("transformer_b8s48", transformer(8, 128, 8, 48, layers=1)),
+        ("transformer_b16s64", transformer(16, 32, 2, 64, layers=1)),
+        ("transformer_b4s24", transformer(4, 192, 6, 24, layers=1)),
+        ("transformer_b8s64", transformer(8, 96, 4, 64, layers=1)),
+    ]
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "tests", "fixtures", "costmodel")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.obs.artifacts import write_artifact
+    from flexflow_tpu.obs.simtrace import simtrace_report
+    from flexflow_tpu.optimizers import SGDOptimizer
+    from flexflow_tpu.search.profile import microbenchmark
+    from flexflow_tpu.search.validate import simulate_strategy
+
+    # fixtures must be analytic-priced regardless of any model already
+    # trained in this checkout (a corpus must never train on itself)
+    os.environ["FFS_NO_LEARNED_COSTS"] = "1"
+    total = 0
+    for stem, build in build_family():
+        cfg = FFConfig()
+        cfg.search_budget = 1
+        cfg.enable_parameter_parallel = True
+        ff, loss_kind = build(cfg)
+        loss = (LossType.MEAN_SQUARED_ERROR_AVG_REDUCE
+                if loss_kind == "mse"
+                else LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        ff.compile(SGDOptimizer(lr=0.01), loss)
+        measured = microbenchmark(ff.executor.nodes, repeats=2)
+        resp = simulate_strategy(ff)
+        report = simtrace_report(ff, resp, measured=measured)
+        n_meas = sum(1 for r in report["per_op"]
+                     if (r.get("measured") or {}).get("source")
+                     == "measured")
+        path = os.path.join(out_dir, f"{stem}_r00_host00.simtrace.json")
+        write_artifact(path, report, host_id=0, kind="simtrace",
+                       header_extra=dict(run_name=stem, run_seq=0))
+        print(f"{stem}: {len(report['per_op'])} ops "
+              f"({n_meas} measured) -> {path}")
+        total += n_meas
+    print(f"total measured rows: {total}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

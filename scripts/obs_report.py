@@ -201,6 +201,18 @@ def summarize_run(stem, arts):
         if pred.get("step_s") and meas_p50:
             sim["predicted_vs_measured"] = _round(
                 pred["step_s"] / meas_p50, 4)
+        # simulator-accuracy block (learned cost model, ISSUE 14): which
+        # model priced each op, and — when the prediction used learned
+        # costs — the analytic twin's step prediction side by side, so
+        # the tracked accuracy metric shows what the learned table buys
+        if simtrace.get("cost_sources"):
+            sim["cost_sources"] = simtrace["cost_sources"]
+        pred_an = (simtrace.get("predicted_analytic") or {}).get("step_s")
+        if pred_an is not None:
+            sim["predicted_analytic_step_s"] = _round(pred_an, 9)
+            if meas_p50:
+                sim["predicted_vs_measured_analytic"] = _round(
+                    pred_an / meas_p50, 4)
         row["sim"] = sim
         attr = per_op_attribution(simtrace, drift)
         if attr:
@@ -297,16 +309,27 @@ def to_markdown(report):
                          f"{'-' if ing is None else ing} |")
     sims = [r for r in report["runs"] if r.get("sim")]
     if sims:
-        lines += ["", "## Simulated vs measured step", "",
-                  "| run | predicted step ms | measured p50 ms | "
-                  "pred/meas |",
-                  "|---|---|---|---|"]
+        lines += ["", "## Simulator accuracy (predicted vs measured "
+                  "step)", "",
+                  "(active = whichever cost model priced the run — "
+                  "`sources` counts ops per pricing source; the "
+                  "analytic column appears when a learned table was "
+                  "active, so the two models read side by side)", "",
+                  "| run | predicted ms | analytic ms | measured p50 ms "
+                  "| pred/meas | analytic/meas | sources |",
+                  "|---|---|---|---|---|---|---|"]
         for r in sims:
             s = r["sim"]
+            srcs = s.get("cost_sources") or {}
+            src_str = " ".join(f"{k}:{v}" for k, v in sorted(srcs.items())
+                               ) or "-"
             lines.append(
                 f"| {r['run']} | {_fmt(s.get('predicted_step_s'), 1e3)} | "
+                f"{_fmt(s.get('predicted_analytic_step_s'), 1e3)} | "
                 f"{_fmt(r.get('step_time_p50_s'), 1e3)} | "
-                f"{_fmt(s.get('predicted_vs_measured'))} |")
+                f"{_fmt(s.get('predicted_vs_measured'))} | "
+                f"{_fmt(s.get('predicted_vs_measured_analytic'))} | "
+                f"{src_str} |")
     attrs = [(r["run"], row) for r in report["runs"]
              for row in (r.get("per_op_attribution") or {}).get("rows", [])]
     if attrs:

@@ -113,7 +113,7 @@ class TestGoldenReport:
         md = mod.to_markdown(report)
         assert "# Observability run report" in md
         assert "## Measured vs priced collectives" in md
-        assert "## Simulated vs measured step" in md
+        assert "## Simulator accuracy (predicted vs measured step)" in md
         assert "## Per-op predicted vs measured" in md
         assert "demo_r00_host00" in md
 
