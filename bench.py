@@ -456,6 +456,28 @@ def hbm_peak_of(summary):
     return float(b) if b else None
 
 
+def dispatch_count_of(summary):
+    """Kernel launches per compiled step (HLO fusion census: fusions +
+    custom calls + collectives), or None. The dispatch-bound hot path's
+    coordinate — the one the searched kernel dimension (ISSUE 15)
+    moves."""
+    f = (summary or {}).get("fusions") or {}
+    d = f.get("dispatches")
+    return int(d) if d else None
+
+
+def dispatch_ratchet(hist, key, dispatches, tol=0.05):
+    """Downward ratchet on the per-step dispatch count, alongside
+    ``collective_bytes``/``hbm_peak_bytes``: a change that un-fuses the
+    hot path (more kernel launches) fails the bench even when wall
+    clock hides it. Compile-determined but XLA-version-sensitive, so a
+    slightly wider tolerance than the byte ratchets plus 2 launches of
+    absolute slack. FFS_SKIP_CENSUS=1 opts out upstream (no summary ->
+    no engagement)."""
+    return _low_water_ratchet(hist, key, "dispatch_count",
+                              float(dispatches), tol, abs_tol=2.0)
+
+
 def step_time_stats(step_samples, iters):
     """p50/p99 of the steady-state per-step dispatch intervals: the
     first window (index < iters) still fills the async pipeline, so it
@@ -725,6 +747,32 @@ def main():
                 memory_regressions.append(
                     f"{name}: {hbm_peak:.0f} B peak vs recorded best "
                     f"{peak_base:.0f}")
+        dispatches = dispatch_count_of(summary)
+        if dispatches is not None:
+            # dispatch-count sibling (ISSUE 15): the kernel-search
+            # dimension's coordinate — un-fusing the hot path (more
+            # launches per step) fails loudly even when wall clock
+            # doesn't move on this round's hardware
+            dreg, dbase = dispatch_ratchet(hist, key, dispatches)
+            wl["dispatch_count"] = dispatches
+            if dreg:
+                census_regressions.append(
+                    f"{name}: {dispatches} dispatches/step vs recorded "
+                    f"best {dbase:.0f}")
+        # per-op kernel choices (provenance, informational): which impls
+        # this round's strategy executed — seeded into the history entry
+        # so cross-round diffs show kernel-choice flips. Searched models
+        # record the "_k:" choices; heuristic workloads record the
+        # attention dispatch (selected_impl) so the column never goes
+        # silently absent.
+        kc = dict(getattr(ff, "kernel_choices", None) or {})
+        if not kc:
+            from flexflow_tpu.search.unity import executed_kernel_choices
+            axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+            kc = executed_kernel_choices(ff.executor.nodes, ff.strategy,
+                                         axes, training=True)
+        if kc:
+            wl["kernel_choices"] = dict(sorted(kc.items()))
         # informational observability fields (ISSUE 6): step-time
         # distribution + MFU next to the ratchets — recorded into the
         # history entry for cross-round comparison, but NOT gated (chip
@@ -765,12 +813,16 @@ def main():
         if isinstance(ent, dict):
             ent.update({k: wl[k] for k in
                         ("step_time_p50", "step_time_p99", "mfu",
-                         "sim_accuracy_ratio")
+                         "sim_accuracy_ratio", "kernel_choices")
                         if k in wl})
             if "sim_accuracy_ratio" not in wl:
                 # a failed replay must not leave a PREVIOUS round's
                 # ratio sitting next to this round's step times
                 ent.pop("sim_accuracy_ratio", None)
+            if "kernel_choices" not in wl:
+                # same stale-field discipline: a round that records no
+                # kernel choices must not inherit a previous round's
+                ent.pop("kernel_choices", None)
         if name == "bert_proxy":
             result.update({
                 "metric": "bert_proxy_train_throughput",
@@ -848,7 +900,8 @@ def searched_vs_dp_ratio(on_cpu):
                            only_data_parallel=True, workers_per_node=1))
         ff.compile(SGDOptimizer(lr=0.01),
                    LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
-        nodes = serialize_graph(ff.executor.nodes)
+        nodes = serialize_graph(ff.executor.nodes,
+                                final_guid=ff.executor.final_ref[0])
         machine = machine_to_json(
             MachineSpec(chip="tpu-v4", chips_per_slice=n_chips), n_chips)
         base_cfg = dict(budget=8, alpha=0.05, training=True, overlap=True,
@@ -870,12 +923,27 @@ def searched_vs_dp_ratio(on_cpu):
             config=dict(base_cfg, only_data_parallel=True)))
         r = dp["predicted_time"] / searched["predicted_time"]
         mesh = {k: v for k, v in searched["mesh"].items() if v > 1}
+        # the searched strategy's kernel choices (ISSUE 15): which
+        # "_k:" impls the simulated v4-32 search committed to, keyed by
+        # op — the per-workload kernel_choices record for strategies
+        # that actually SEARCH (the CPU proxy workloads run heuristic
+        # single-device strategies and record none)
+        from flexflow_tpu.search.unity import kernel_choice_of
+        by_guid = {n.op.guid: n.op.name for n in ff.executor.nodes}
+        kchoices = {}
+        for guid, oj in (searched.get("ops") or {}).items():
+            impl = kernel_choice_of(oj.get("choice"))
+            if impl is not None:
+                kchoices[by_guid.get(int(guid), guid)] = impl
         out = {
             "searched_vs_dp_v4_32": round(r, 3),
             "searched_mesh_v4_32": mesh or {"data": 1},
             "north_star_model": ("transformer_tiny" if on_cpu
                                  else "bert_large_24L"),
         }
+        if kchoices:
+            out["searched_kernel_choices_v4_32"] = dict(sorted(
+                kchoices.items()))
         if searched.get("pipeline"):
             out["searched_microbatches_v4_32"] = \
                 searched["pipeline"]["microbatches"]

@@ -306,9 +306,136 @@ class CollectiveInferencePass:
                          "search leaves comms-compute overlap unused"))
         return out
 
+    def _kernel_choice_checks(self, ctx) -> List[Diagnostic]:
+        """FFL208 (ERROR): a strategy's recorded ``_k:`` kernel choice
+        is structurally illegal on the executing shape — the search
+        priced a lowering decode cannot deliver (a stale strategy file,
+        or a seq-bucket/graph edit after the search). FFL209 (INFO): the
+        choice is shape-legal but THIS platform cannot run it (Pallas
+        off / below the hardware take-over threshold) — the executor
+        silently falls back, so the priced and the executed kernel
+        differ. The same priced-vs-executed closure FFL207 gave the
+        '_ovl' dimension."""
+        from flexflow_tpu.ffconst import OperatorType
+        from flexflow_tpu.ops.pallas_kernels import (BLK_Q, pallas_mode)
+        from flexflow_tpu.search.unity import kernel_choice_of
+
+        out: List[Diagnostic] = []
+        fusable = None
+        for node in ctx.nodes:
+            ch = getattr(ctx.strategy.get(node.op.guid), "choice",
+                         None) or ""
+            impl = kernel_choice_of(ch)
+            if impl is None:
+                continue
+            op = node.op
+            if impl == "flash":
+                if op.op_type != OperatorType.MULTIHEAD_ATTENTION:
+                    out.append(error(
+                        "FFL208",
+                        f"'_k:flash' recorded on a non-attention op",
+                        op=op.name, hint="re-search the strategy"))
+                    continue
+                seq = op.input_shapes[0][1]
+                sk = (op.input_shapes[1][1]
+                      if len(op.input_shapes) > 1 else seq)
+                if sk != seq:
+                    out.append(error(
+                        "FFL208",
+                        f"'_k:flash' recorded on cross-attention "
+                        f"(Sq={seq} != Sk={sk}) — flash only lowers "
+                        f"self-attention",
+                        op=op.name,
+                        hint="the graph changed since the search — "
+                             "re-search the strategy"))
+                    continue
+                training = True
+                if ctx.ff is not None and ctx.ff.executor is not None:
+                    training = getattr(ctx.ff.executor, "comp_mode",
+                                       CompMode.TRAINING) \
+                        == CompMode.TRAINING
+                if seq % BLK_Q or op.head_dim % 8:
+                    out.append(error(
+                        "FFL208",
+                        f"'_k:flash' is illegal at this shape (seq={seq}"
+                        f" % {BLK_Q} != 0 or head_dim={op.head_dim} % 8"
+                        f" != 0) — the priced kernel cannot execute",
+                        op=op.name,
+                        hint="re-search (the flash gate rejects this "
+                             "shape) or drop the stale strategy file"))
+                elif training and getattr(op, "dropout", 0) > 0:
+                    # mirrors the native gate's
+                    # attention_prob_dropout_unsupported: the training
+                    # forward can never take the flash branch
+                    out.append(error(
+                        "FFL208",
+                        f"'_k:flash' recorded on an attention op with "
+                        f"prob dropout ({op.dropout}) — the training "
+                        f"forward has no flash lowering for it",
+                        op=op.name,
+                        hint="the dropout changed since the search — "
+                             "re-search the strategy"))
+                else:
+                    from flexflow_tpu.ops.pallas_kernels import (
+                        flash_attention_available)
+                    if not flash_attention_available(seq, op.head_dim):
+                        out.append(info(
+                            "FFL209",
+                            f"'_k:flash' was priced but this platform "
+                            f"falls back to einsum (pallas mode "
+                            f"'{pallas_mode()}', seq={seq}) — the "
+                            f"executed kernel differs from the priced "
+                            f"one",
+                            op=op.name,
+                            hint="set FLEXFLOW_TPU_PALLAS=interpret "
+                                 "(tests) or run on TPU; predictions "
+                                 "for this op are optimistic meanwhile"))
+            elif impl == "conv_bn_fused":
+                if fusable is None:
+                    from flexflow_tpu.layout import train_fusable_conv_guids
+                    # same keep_guids as the executor's fuse_conv_bn_train:
+                    # the check must agree with what EXECUTES
+                    keep = ()
+                    if ctx.ff is not None and ctx.ff.executor is not None:
+                        keep = {ctx.ff.executor.final_ref[0]}
+                    fusable = train_fusable_conv_guids(ctx.nodes,
+                                                      keep_guids=keep)
+                if op.guid not in fusable:
+                    out.append(error(
+                        "FFL208",
+                        "'_k:conv_bn_fused' recorded but the conv no "
+                        "longer has a foldable BatchNorm sole consumer",
+                        op=op.name,
+                        hint="the graph changed since the search — "
+                             "re-search the strategy"))
+            elif impl == "fused":
+                ex = ctx.ff.executor if ctx.ff is not None else None
+                if ex is not None and op.name not in (
+                        getattr(ex, "fused_update_ops", None) or ()):
+                    out.append(info(
+                        "FFL209",
+                        "'_k:fused' was priced but the executor is not "
+                        "routing this op's update through the fused "
+                        "region (kernel search disabled at compile?)",
+                        op=op.name,
+                        hint="compile with --kernel-search auto so the "
+                             "executed update matches the priced one"))
+        # runtime-recorded silent fallbacks (the executor sets
+        # _kernel_fallback the first time a forced impl cannot run)
+        for node in ctx.nodes:
+            fb = getattr(node.op, "_kernel_fallback", None)
+            if fb:
+                out.append(info(
+                    "FFL209", f"executor fell back: {fb}",
+                    op=node.op.name,
+                    hint="the priced kernel never ran — simulated "
+                         "predictions for this op are optimistic"))
+        return out
+
     def run(self, ctx) -> List[Diagnostic]:
         diags: List[Diagnostic] = []
         diags.extend(self._overlap_rejections(ctx))
+        diags.extend(self._kernel_choice_checks(ctx))
         inferred = infer_strategy_collectives(ctx)
         priced: Optional[Dict[str, float]] = None
         try:

@@ -121,6 +121,16 @@ class FFConfig:
     # An explicit N forces N-MB buckets; '0'/'off' disables both the
     # executor structuring and the search dimension.
     overlap_bucket_mb: str = "auto"
+    # kernel-implementation search (ISSUE 15): 'auto' lets the native DP
+    # enumerate "_k:<impl>" choice twins — flash vs einsum attention,
+    # the fused one-dispatch optimizer update vs the RS->triad->AG
+    # chain, train-time Conv+BN fusion — each priced per-impl
+    # (measured > learned > analytic HBM-traffic delta) and executed by
+    # the per-op kernel plumbing. 'off' (or FFS_NO_KERNEL_SEARCH=1)
+    # removes the dimension: searches reproduce pre-kernel-search
+    # results bit-identically and the executor keeps its availability-
+    # based defaults.
+    kernel_search: str = "auto"
     # fflint static verification at compile time (flexflow_tpu/analysis):
     # "off" skips it, "warn" prints the report, "error" additionally
     # raises when any ERROR-severity diagnostic fires (illegal sharding
@@ -312,6 +322,12 @@ class FFConfig:
                             f"--overlap-bucket-mb expects auto|off|N (MB), "
                             f"got {v!r}") from None
                 self.overlap_bucket_mb = v
+            elif a == "--kernel-search":
+                v = take().lower()
+                if v not in ("auto", "off"):
+                    raise ValueError(
+                        f"--kernel-search expects auto|off, got {v!r}")
+                self.kernel_search = v
             elif a == "--weight-update-sharding":
                 v = take().lower()
                 if v not in ("auto", "on", "off"):

@@ -41,6 +41,50 @@ import numpy as np
 # rows up to THIS version and refuses newer ones.
 from flexflow_tpu.obs.simtrace import CORPUS_SCHEMA_VERSION
 
+# Oldest row schema still trainable: v2 introduced the featurization
+# fields; v3 only ADDED the ``impl`` column (derivable from the choice
+# suffix for v2 rows), so the committed v2 fixture corpus keeps
+# training.
+CORPUS_MIN_TRAINABLE = 2
+
+# Kernel impls that change the COMPUTE lowering — these get their own
+# learned class ("TYPE:impl", mirrored by the native evaluator's lookup
+# in ffs_strategy.hpp learned_compute). Ring attention and the fused
+# update keep the base class: ring's per-block compute IS the einsum
+# (its ring comm is priced separately) and "fused" only moves the
+# update term, not fwd/bwd compute.
+_COMPUTE_IMPLS = frozenset({"flash", "conv_bn_fused"})
+
+
+def row_impl(row: Dict[str, Any]) -> Optional[str]:
+    """Kernel impl of a corpus row: the v3 ``impl`` column, else derived
+    from the choice suffix (v2 rows)."""
+    impl = row.get("impl")
+    if impl:
+        return str(impl)
+    from flexflow_tpu.search.unity import kernel_choice_of
+    ch = row.get("choice") or ""
+    k = kernel_choice_of(ch)
+    if k is not None:
+        return k
+    t = row.get("type")
+    if t == "MULTIHEAD_ATTENTION":
+        return "ring" if "_ring" in ch else "einsum"
+    if t == "CONV2D":
+        return "conv"
+    return None
+
+
+def row_class(row: Dict[str, Any]) -> str:
+    """Learned-model class key of a row: the op type, suffixed
+    ``:impl`` for compute-kernel impls so per-impl rows train per-impl
+    coefficients instead of blending two lowerings into one
+    regression."""
+    impl = row_impl(row)
+    if impl in _COMPUTE_IMPLS:
+        return f"{row.get('type')}:{impl}"
+    return str(row.get("type"))
+
 # The featurization the regression trains over and the native evaluator
 # mirrors (ffs_machine.hpp kLearnedFeatures — same order, same
 # transforms). All log-space: per-op seconds span 6 orders of
@@ -82,6 +126,7 @@ def row_key(row: Dict[str, Any]) -> Tuple:
     return (
         row.get("platform") or "unknown",
         row.get("type"),
+        row_impl(row),
         tuple(row.get("out_shape") or ()),
         row.get("choice"),
         tuple(sorted((str(k), int(v)) for k, v in mesh.items())),
@@ -126,7 +171,7 @@ def rows_from_simtrace(payload: Dict[str, Any], path: str,
     for r in payload.get("per_op") or []:
         ver = r.get("schema", 1)
         _check_schema(ver, path)
-        if int(ver) < CORPUS_SCHEMA_VERSION:
+        if int(ver) < CORPUS_MIN_TRAINABLE:
             skipped += 1  # pre-featurization row: nothing to train on
             continue
         row = dict(r)
@@ -241,7 +286,8 @@ def build_corpus(trace_dirs: Sequence[str]) -> Dict[str, Any]:
     rows = list(by_key.values())
     classes: Dict[str, int] = {}
     for r in rows:
-        classes[r["type"]] = classes.get(r["type"], 0) + 1
+        c = row_class(r)
+        classes[c] = classes.get(c, 0) + 1
     return dict(
         schema_version=1,
         corpus_schema=CORPUS_SCHEMA_VERSION,

@@ -35,7 +35,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from flexflow_tpu.costmodel.corpus import (CORPUS_SCHEMA_VERSION,
-                                           FEATURE_NAMES, featurize, row_key)
+                                           FEATURE_NAMES, featurize,
+                                           row_class, row_key)
 
 MODEL_SCHEMA_VERSION = 1
 
@@ -172,9 +173,12 @@ class CostModel:
                 else "unknown"
         rows = [r for r in all_rows
                 if (r.get("platform") or "unknown") == platform]
+        # per-impl classes ("TYPE:impl" for compute-kernel impls — the
+        # searched "_k:" dimension, ISSUE 15): flash rows never blend
+        # into the einsum regression they'd otherwise bias
         by_class: Dict[str, List[Dict[str, Any]]] = {}
         for r in rows:
-            by_class.setdefault(r["type"], []).append(r)
+            by_class.setdefault(row_class(r), []).append(r)
         classes: Dict[str, ClassModel] = {}
         for cname, crows in sorted(by_class.items()):
             if len(crows) < min_rows:
@@ -215,7 +219,8 @@ class CostModel:
         ``None`` when the op class has no trained regression.
         Confidence = coverage term x hull term — outside the trained
         feature hull it decays toward 0 (extrapolation)."""
-        cm = self.classes.get(row.get("type"))
+        cm = self.classes.get(row_class(row)) \
+            or self.classes.get(row.get("type"))
         if cm is None:
             return None, 0.0
         f = featurize(row)
@@ -226,7 +231,8 @@ class CostModel:
         return t, float(conf)
 
     def in_hull(self, row: Dict[str, Any]) -> bool:
-        cm = self.classes.get(row.get("type"))
+        cm = self.classes.get(row_class(row)) \
+            or self.classes.get(row.get("type"))
         if cm is None:
             return False
         f = featurize(row)

@@ -71,6 +71,7 @@ class GraphExecutor:
         wus_ops: Optional[set] = None,
         overlap_grad_sync: bool = False,
         overlap_bucket_bytes: int = 4 << 20,
+        kernel_choices: Optional[Dict[str, str]] = None,
     ):
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
@@ -122,6 +123,20 @@ class GraphExecutor:
         self.grad_overlap = bool(overlap_grad_sync
                                  and self.weight_update_sharding)
         self.overlap_bucket_bytes = max(1, int(overlap_bucket_bytes))
+        # per-op searched kernel implementations (ISSUE 15): {op name ->
+        # impl}. "fused" routes the op's optimizer update through the
+        # one-dispatch fused region (ops/fused_update.py, bit-compatible
+        # with the triad); "conv_bn_fused" executes the Conv2D and its
+        # BatchNorm consumer as one fused train-time region
+        # (layout.TrainFusedConvBN); attention impls ("flash"/"einsum")
+        # live on the op itself (MultiHeadAttention.kernel_impl, set by
+        # apply_strategy). None = no searched kernel dimension — every
+        # op keeps its availability-based default, bit-identical to
+        # pre-kernel-search execution.
+        self.kernel_choices = dict(kernel_choices) if kernel_choices else None
+        self.fused_update_ops = {
+            n for n, impl in (self.kernel_choices or {}).items()
+            if impl == "fused"}
         self._by_name = {n.op.name: n for n in nodes}
         self._jit_train = None
         self._jit_eval = None
@@ -464,11 +479,27 @@ class GraphExecutor:
             ]
             sources = getattr(op, "param_sources", None)
             if sources is not None:
-                # fused execution-time op (FoldedConvBN): reads the
+                # fused execution-time op (FoldedConvBN eval fold /
+                # TrainFusedConvBN searched kernel): reads the
                 # parameter/state subtrees of the ops it folded
                 outs = op.forward(
                     {s: params.get(s, {}) for s in sources}, args, ctx,
                     state={s: state.get(s) for s in sources})
+                # train-time fused regions update their sources' state
+                # (BN running stats) under the SOURCE names, keeping the
+                # state tree's shape checkpoint-compatible
+                ns = getattr(op, "_new_states", None)
+                if ns:
+                    new_state.update(ns)
+                    op._new_states = None
+                else:
+                    for s in sources:
+                        if s in state and state[s] is not None \
+                                and hasattr(self._by_name.get(s, None),
+                                            "op") \
+                                and hasattr(self._by_name[s].op,
+                                            "init_state"):
+                            new_state.setdefault(s, state[s])
             elif hasattr(op, "init_state"):
                 outs = op.forward(params.get(op.name, {}), args, ctx,
                                   state=state.get(op.name))
@@ -512,9 +543,39 @@ class GraphExecutor:
             return -jnp.mean(jnp.take_along_axis(logp, lab[:, None], axis=-1))
         return fn(logits, labels)
 
+    def _training_nodes(self):
+        """Node list the TRAIN step runs: (Conv2D, BatchNorm) pairs whose
+        searched kernel choice is ``_k:conv_bn_fused`` execute as one
+        fused region (layout.TrainFusedConvBN — batch-stats BN, state
+        updates preserved); everything else is ``self.nodes`` untouched.
+        Built once per executor."""
+        names = {n for n, impl in (self.kernel_choices or {}).items()
+                 if impl == "conv_bn_fused"}
+        if not names:
+            return self.nodes
+        if not hasattr(self, "_train_fused_nodes"):
+            from flexflow_tpu.layout import fuse_conv_bn_train
+            self._train_fused_nodes = fuse_conv_bn_train(
+                self.nodes, names, keep_guids={self.final_ref[0]})
+        return self._train_fused_nodes
+
+    def _optimizer_update(self, grads, opt_state, params):
+        """Optimizer update honoring per-op ``_k:fused`` kernel choices:
+        the chosen ops' leaves update through the one-dispatch fused
+        region (ops/fused_update.py, bit-compatible with the reference
+        triad); the rest take ``optimizer.update`` unchanged. No fused
+        choices = exactly the pre-kernel-search call."""
+        fused = {n for n in self.fused_update_ops if n in params}
+        if not fused:
+            return self.optimizer.update(grads, opt_state, params)
+        from flexflow_tpu.ops.fused_update import fused_optimizer_update
+        return fused_optimizer_update(self.optimizer, grads, opt_state,
+                                      params, fused)
+
     def _train_step_fn(self):
         """The raw (unjitted) train-step function, for composition into
         multi-step scans."""
+        train_nodes = self._training_nodes()
 
         def train_step(params, opt_state, state, inputs, labels, rng):
             cparams = (state[COMPUTE_PARAMS_KEY]
@@ -524,7 +585,8 @@ class GraphExecutor:
                 ctx = OpContext(training=True, rng=rng,
                                 compute_dtype=self.compute_dtype,
                                 mesh=self.mesh)
-                values, new_state, aux = self.run_graph(p, state, inputs, ctx)
+                values, new_state, aux = self.run_graph(p, state, inputs, ctx,
+                                                        nodes=train_nodes)
                 logits = values[self.final_ref]
                 loss = self._loss_value(logits, labels)
                 for a in aux:
@@ -540,7 +602,7 @@ class GraphExecutor:
             # reduce-scatter: each chip receives only the gradient shard
             # whose master-param/moment shard it owns.
             grads = self._wus_shard(grads)
-            new_params, new_opt_state = self.optimizer.update(
+            new_params, new_opt_state = self._optimizer_update(
                 grads, opt_state, params
             )
             new_params = self._wus_shard(new_params)

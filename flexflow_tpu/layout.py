@@ -233,16 +233,20 @@ class FoldedConvBN:
         return f"FoldedConvBN({self.name})"
 
 
-def fold_conv_bn(nodes, keep_guids=()):
-    """Fold eligible Conv2D→BatchNorm pairs in an OpNode list.
+def train_fusable_conv_guids(nodes, keep_guids=()) -> set:
+    """Conv2D guids whose sole consumer is a foldable BatchNorm — the
+    shared eligibility of the eval-time fold (``fold_conv_bn``) and the
+    searched train-time ``_k:conv_bn_fused`` kernel twin (the conv node
+    ships it to the native search as the ``bn_fusable`` attr, since the
+    per-node choice enumeration cannot re-derive a graph property)."""
+    return {conv_guid for conv_guid, _ in _fusable_pairs(nodes, keep_guids)}
 
-    Eligible: the BN's sole input is a Conv2D output that nothing else
-    consumes (and whose guid is not in ``keep_guids`` — e.g. the
-    designated model output), and the conv carries no activation of its
-    own (the BN owns the ReLU). Returns a NEW node list; the input list
-    is never mutated, so the training executables keep the full graph.
-    """
-    from flexflow_tpu.executor import OpNode
+
+def _fusable_pairs(nodes, keep_guids=()):
+    """(conv guid, bn guid) pairs eligible for Conv+BN fusion: the BN's
+    sole input is a Conv2D output nothing else consumes, the conv
+    carries no activation of its own, and the conv output is not the
+    designated model output."""
     from flexflow_tpu.ops.conv import BatchNorm, Conv2D
 
     consumers: Dict[Tuple[int, int], int] = {}
@@ -252,8 +256,7 @@ def fold_conv_bn(nodes, keep_guids=()):
                 k = (ref[1], ref[2])
                 consumers[k] = consumers.get(k, 0) + 1
     by_guid = {n.op.guid: n for n in nodes}
-    folded_conv_guids = set()
-    replacements: Dict[int, OpNode] = {}  # bn guid -> fused node
+    pairs = []
     for node in nodes:
         op = node.op
         if not isinstance(op, BatchNorm):
@@ -268,12 +271,138 @@ def fold_conv_bn(nodes, keep_guids=()):
             continue
         if consumers.get((ref[1], 0), 0) != 1 or ref[1] in keep_guids:
             continue
-        fused = OpNode(FoldedConvBN(prod.op, op), list(prod.input_refs))
+        pairs.append((prod.op.guid, op.guid))
+    return pairs
+
+
+class TrainFusedConvBN:
+    """Conv2D + BatchNorm executed as ONE fused region at TRAIN time —
+    the ``_k:conv_bn_fused`` searched kernel choice (ISSUE 15).
+
+    Training BN normalizes with batch statistics, so nothing folds into
+    the conv weights (that is the eval-only ``FoldedConvBN``); instead
+    the two ops execute inside one composite node: the intermediate
+    conv output never becomes a first-class graph value (no separate
+    node boundary, no per-node bookkeeping between them), so XLA fuses
+    the BN's normalization into the conv's epilogue where the unfused
+    lowering emits separate regions. The conv output's sharding
+    constraint is PRESERVED inside the fused forward — the lowering is
+    collective-for-collective identical to the unfused pair, which is
+    what makes the parity bit-for-bit (tests/test_kernel_search.py).
+
+    BN's running-stats state update flows out through ``_new_states``
+    (the executor merges it under the BN's own name, so the state tree
+    keeps its shape and checkpoints stay compatible).
+    """
+
+    def __init__(self, conv_node, bn_node):
+        conv_op, bn_op = conv_node.op, bn_node.op
+        self.conv = conv_op
+        self.bn = bn_op
+        self.name = f"{conv_op.name}+{bn_op.name}"
+        self.guid = bn_op.guid  # consumers reference the BN output
+        self.op_type = OperatorType.CONV2D
+        self.input_shapes = list(conv_op.input_shapes)
+        self.output_shapes = list(bn_op.output_shapes)
+        self.dtype = conv_op.dtype
+        self.param_sources = (conv_op.name, bn_op.name)
+        # the conv output's sharding constraint, re-applied between the
+        # two halves (permuted when the conv executes channels-last)
+        spec = conv_node.output_specs[0] if conv_node.output_specs else None
+        ols = getattr(conv_node, "output_layouts", None)
+        if spec is not None and ols and ols[0] == NHWC:
+            spec = permute_spec_nhwc(spec)
+        self._mid_spec = spec
+        self._new_states = None
+
+    @property
+    def exec_layout(self):
+        return getattr(self.conv, "exec_layout", NCHW)
+
+    def output_dim_roles(self):
+        return self.bn.output_dim_roles()
+
+    def flops(self):
+        return self.conv.flops()
+
+    def params_elems(self):
+        return 0  # reads its sources' params; owns none
+
+    def forward(self, params, inputs, ctx, state=None):
+        y = self.conv.forward(params.get(self.conv.name, {}), inputs,
+                              ctx)[0]
+        if self._mid_spec is not None and ctx.mesh is not None:
+            from jax.sharding import NamedSharding
+            import jax
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(ctx.mesh, self._mid_spec))
+        outs = self.bn.forward(params.get(self.bn.name, {}), [y], ctx,
+                               state=(state or {}).get(self.bn.name))
+        if getattr(self.bn, "_new_state", None) is not None:
+            self._new_states = {self.bn.name: self.bn._new_state}
+            self.bn._new_state = None
+        return outs
+
+    def __repr__(self):
+        return f"TrainFusedConvBN({self.name})"
+
+
+def fuse_conv_bn_train(nodes, conv_names, keep_guids=()):
+    """Fuse the (Conv2D, BatchNorm) pairs whose conv op NAME is in
+    ``conv_names`` (the executor's ``_k:conv_bn_fused`` kernel choices)
+    into TrainFusedConvBN nodes. Returns a NEW node list; ineligible or
+    unchosen pairs stay untouched, so a stale kernel choice degrades to
+    the unfused lowering (fflint FFL209 flags the gap)."""
+    from flexflow_tpu.executor import OpNode
+
+    chosen = {(cg, bg) for cg, bg in _fusable_pairs(nodes, keep_guids)}
+    by_guid = {n.op.guid: n for n in nodes}
+    folded_conv_guids = set()
+    replacements: Dict[int, OpNode] = {}
+    for conv_guid, bn_guid in chosen:
+        conv_node, bn_node = by_guid[conv_guid], by_guid[bn_guid]
+        if conv_node.op.name not in conv_names:
+            continue
+        fused = OpNode(TrainFusedConvBN(conv_node, bn_node),
+                       list(conv_node.input_refs))
+        fused.output_specs = list(bn_node.output_specs)
+        fused.input_layouts = list(getattr(conv_node, "input_layouts", []))
+        fused.output_layouts = list(getattr(bn_node, "output_layouts", []))
+        replacements[bn_guid] = fused
+        folded_conv_guids.add(conv_guid)
+    if not replacements:
+        return nodes
+    out = []
+    for node in nodes:
+        if node.op.guid in folded_conv_guids:
+            continue
+        out.append(replacements.get(node.op.guid, node))
+    return out
+
+
+def fold_conv_bn(nodes, keep_guids=()):
+    """Fold eligible Conv2D→BatchNorm pairs in an OpNode list.
+
+    Eligible: the BN's sole input is a Conv2D output that nothing else
+    consumes (and whose guid is not in ``keep_guids`` — e.g. the
+    designated model output), and the conv carries no activation of its
+    own (the BN owns the ReLU). Returns a NEW node list; the input list
+    is never mutated, so the training executables keep the full graph.
+    """
+    from flexflow_tpu.executor import OpNode
+
+    by_guid = {n.op.guid: n for n in nodes}
+    folded_conv_guids = set()
+    replacements: Dict[int, OpNode] = {}  # bn guid -> fused node
+    for conv_guid, bn_guid in _fusable_pairs(nodes, keep_guids):
+        prod, node = by_guid[conv_guid], by_guid[bn_guid]
+        fused = OpNode(FoldedConvBN(prod.op, node.op),
+                       list(prod.input_refs))
         fused.output_specs = list(node.output_specs)
         fused.input_layouts = list(getattr(prod, "input_layouts", []))
         fused.output_layouts = list(getattr(node, "output_layouts", []))
-        replacements[op.guid] = fused
-        folded_conv_guids.add(prod.op.guid)
+        replacements[bn_guid] = fused
+        folded_conv_guids.add(conv_guid)
     if not replacements:
         return nodes
     out = []

@@ -756,6 +756,84 @@ class FFModel:
             bucket_mb = float(int(ovl_raw))
             overlap = bucket_mb > 0
         self.overlap_enabled = bool(overlap and wus)
+        # kernel-implementation choices (ISSUE 15): the search prices
+        # "_k:<impl>" twins per op; the executor honors each op's chosen
+        # lowering through the same per-op plumbing as wus_ops. When the
+        # kernel dimension ran, attention ops whose choice kept the
+        # DEFAULT impl are pinned to it ("einsum") so the executor's
+        # availability-based auto-pick cannot silently run a kernel the
+        # DP priced AND rejected (the priced-vs-executed gap FFL209
+        # watches). Off/not-searched leaves every op on auto — the
+        # pre-kernel-search behavior, bit-identical.
+        import os as _os
+        from flexflow_tpu.search.unity import kernel_choice_of
+        kernel_on = ((searched or any(
+                         "_k:" in (getattr(st, "choice", None) or "")
+                         for st in (self.strategy or {}).values()))
+                     and str(getattr(cfg, "kernel_search", "auto")).lower()
+                     != "off"
+                     and not _os.environ.get("FFS_NO_KERNEL_SEARCH"))
+        # pipe-mesh winners never enumerated the kernel dimension (the
+        # native search gates "_k:" twins off pp>1 meshes) — pinning
+        # attention to einsum there would disable the availability-based
+        # flash auto-pick the DP never priced an alternative to
+        if axes_now.get("pipe", 1) > 1:
+            kernel_on = False
+        kernel_choices: Optional[Dict[str, str]] = None
+        if kernel_on:
+            kernel_choices = {}
+            for n in nodes:
+                ch = getattr((self.strategy or {}).get(n.op.guid),
+                             "choice", None) or ""
+                impl = kernel_choice_of(ch)
+                if impl is not None:
+                    kernel_choices[n.op.name] = impl
+                elif n.op.op_type == OperatorType.MULTIHEAD_ATTENTION:
+                    kernel_choices[n.op.name] = ("ring" if "_ring" in ch
+                                                 else "einsum")
+            def _flash_was_enumerable(op):
+                # mirror the native flash gate (ffs_strategy.hpp
+                # kernel_gate): the "einsum" pin below asserts "the DP
+                # priced flash AND rejected it" — which only holds when
+                # a twin could exist for this op. Where the gate
+                # excluded flash (dropout, tile divisibility,
+                # cross-attention) the availability-based auto pick
+                # must survive: eval/serve forwards may legally run
+                # flash even though the TRAINING search never priced it.
+                from flexflow_tpu.ops.pallas_kernels import BLK_Q
+                try:
+                    b, s, e = op.input_shapes[0]
+                    sk = (op.input_shapes[1][1]
+                          if len(op.input_shapes) > 1 else s)
+                    return (sk == s and s % BLK_Q == 0
+                            and op.head_dim % 8 == 0
+                            and not (comp_mode == CompMode.TRAINING
+                                     and op.dropout > 0))
+                except Exception:
+                    return False
+
+            for n in nodes:
+                impl = kernel_choices.get(n.op.name)
+                if not hasattr(n.op, "seq_parallel"):
+                    continue
+                if impl == "flash":
+                    n.op.kernel_impl = impl
+                elif impl == "einsum" and _flash_was_enumerable(n.op):
+                    n.op.kernel_impl = impl
+                n.op._kernel_fallback = None  # fresh compile, fresh record
+        else:
+            # the off switch promises availability-based defaults
+            # bit-identical to pre-kernel-search execution: clear any
+            # kernel_impl apply_strategy pinned from an imported "_k:"
+            # strategy under FFS_NO_KERNEL_SEARCH / --kernel-search off
+            # (and any stale fallback record with it — FFL209 must not
+            # keep firing for a fallback that can no longer occur)
+            for n in nodes:
+                if getattr(n.op, "kernel_impl", None) is not None:
+                    n.op.kernel_impl = None
+                if getattr(n.op, "_kernel_fallback", None) is not None:
+                    n.op._kernel_fallback = None
+        self.kernel_choices = kernel_choices
         exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
                            final_is_softmax=self._final_is_softmax,
                            fold_conv_bn=cfg.fold_conv_bn,
@@ -764,7 +842,8 @@ class FFModel:
                            overlap_grad_sync=overlap,
                            # MB (1e6), matching the native bucket sweep's
                            # wire-byte unit (ffs_strategy.hpp kOvlBucketMB)
-                           overlap_bucket_bytes=int(bucket_mb * 1e6))
+                           overlap_bucket_bytes=int(bucket_mb * 1e6),
+                           kernel_choices=kernel_choices)
         # conv-family execution layout (flexflow_tpu/layout.py): NCHW stays
         # the API/PCG boundary, but on TPU the conv family computes
         # channels-last with boundary transposes hoisted to chain edges.
@@ -1575,7 +1654,8 @@ class FFModel:
                            weight_update_sharding=full.weight_update_sharding,
                            wus_ops=full.wus_ops,
                            overlap_grad_sync=full.grad_overlap,
-                           overlap_bucket_bytes=full.overlap_bucket_bytes)
+                           overlap_bucket_bytes=full.overlap_bucket_bytes,
+                           kernel_choices=full.kernel_choices)
         ex.comp_mode = full.comp_mode
         self._seq_execs[bucket] = ex
         return ex

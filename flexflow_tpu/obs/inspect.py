@@ -94,6 +94,31 @@ def census_totals(census: Dict[str, Dict[str, float]]) -> Dict[str, float]:
     )
 
 
+_FUSION_RE = re.compile(r"=\s+\S+\s+fusion(\.\d+)?\(")
+_CUSTOM_CALL_RE = re.compile(r"=\s+\S+\s+custom-call(\.\d+)?\(")
+
+
+def fusion_census(hlo_text: str,
+                  census: Optional[Dict[str, Dict[str, float]]] = None
+                  ) -> Dict[str, int]:
+    """Dispatch-count proxy over the optimized module: how many kernel
+    launches the step is (fusion regions + custom calls + collectives).
+    The coordinate the kernel-search dimension moves (ISSUE 15: a fused
+    optimizer update collapses three regions into one), tracked by the
+    bench's downward ``dispatch_count`` ratchet the way
+    ``collective_bytes`` tracks the census. ``census``: a
+    collective_census already computed for the same text (avoids a
+    second full-module scan)."""
+    fusions = len(_FUSION_RE.findall(hlo_text))
+    custom = len(_CUSTOM_CALL_RE.findall(hlo_text))
+    if census is None:
+        census = collective_census(hlo_text)
+    colls = sum(e["count"] for e in census.values())
+    return dict(fusions=fusions, custom_calls=custom,
+                collectives=int(colls),
+                dispatches=fusions + custom + int(colls))
+
+
 def inspect_compiled(compiled) -> Dict[str, Any]:
     """Cost + memory analysis + collective census of one jax ``Compiled``.
 
@@ -136,13 +161,17 @@ def inspect_compiled(compiled) -> Dict[str, Any]:
         pass
     out["memory"] = mem
     census: Dict[str, Dict[str, float]] = {}
+    fusions: Optional[Dict[str, int]] = None
     try:
-        census = collective_census(compiled.as_text())
+        text = compiled.as_text()
+        census = collective_census(text)
+        fusions = fusion_census(text, census=census)
     except Exception:
         pass
     out["collectives"] = census
     out["collectives_total"] = census_totals(census)
     out["collectives_min_bytes"] = 0.0
+    out["fusions"] = fusions
     return out
 
 

@@ -40,11 +40,17 @@ SIM_COMMS_KINDS = ("comm", "gradsync")
 
 # Corpus-row schema version of the ``per_op`` rows below. v2 added the
 # featurization fields the learned cost model trains on (flops,
-# io_bytes, param_bytes, dtype_size, mesh degrees, ring sizes) — the
-# costmodel corpus loader (flexflow_tpu/costmodel/corpus.py) refuses
-# rows NEWER than what it understands, so a schema drift here fails the
-# CI costmodel stage loudly instead of silently training on garbage.
-CORPUS_SCHEMA_VERSION = 2
+# io_bytes, param_bytes, dtype_size, mesh degrees, ring sizes); v3 adds
+# the ``impl`` column — WHICH KERNEL ran the op (einsum/flash/ring/
+# conv/conv_bn_fused/triad/fused, the searched ``_k:`` dimension) — so
+# ``scripts/costmodel.py train`` learns per-impl coefficients
+# ("TYPE:impl" classes) instead of blending two lowerings into one
+# regression. The costmodel corpus loader
+# (flexflow_tpu/costmodel/corpus.py) refuses rows NEWER than what it
+# understands, so a schema drift here fails the CI costmodel stage
+# loudly instead of silently training on garbage; v2 rows stay
+# trainable (impl derived from the choice suffix).
+CORPUS_SCHEMA_VERSION = 3
 
 
 def sim_lane_events(tasks: List[Dict[str, Any]],
@@ -114,6 +120,27 @@ def per_op_predicted(tasks: List[Dict[str, Any]]
     return out
 
 
+def _row_impl(ff, op, choice: Optional[str]) -> Optional[str]:
+    """Kernel impl of one corpus row: the ``_k:`` choice suffix when the
+    search picked one, else the executor's recorded kernel choice, else
+    (attention only) the impl ``forward`` dispatches on this platform.
+    None for ops with no registered kernel alternatives."""
+    from flexflow_tpu.search.unity import kernel_choice_of
+    k = kernel_choice_of(choice)
+    if k is not None:
+        return k
+    kc = getattr(ff.executor, "kernel_choices", None) or {}
+    if op.name in kc:
+        return kc[op.name]
+    if hasattr(op, "selected_impl"):
+        try:
+            mesh_axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
+            return op.selected_impl(mesh_axes, training=True)
+        except Exception:
+            return None
+    return None
+
+
 def corpus_rows(ff, resp: Dict[str, Any],
                 measured: Optional[Dict[str, float]] = None
                 ) -> List[Dict[str, Any]]:
@@ -151,13 +178,19 @@ def corpus_rows(ff, resp: Dict[str, Any],
             io_bytes += float(math.prod(s)) * dts
         for s in op.output_shapes:
             io_bytes += float(math.prod(s)) * dts
+        choice = getattr(st, "choice", None)
         rows.append(dict(
             schema=CORPUS_SCHEMA_VERSION,
             guid=op.guid,
             name=op.name,
             type=op.op_type.name,
             out_shape=list(op.output_shapes[0]) if op.output_shapes else [],
-            choice=getattr(st, "choice", None),
+            choice=choice,
+            # which kernel implementation executed the op (the searched
+            # "_k:" dimension, ISSUE 15): the executor's recorded choice
+            # wins; attention ops without one report the impl forward
+            # actually dispatches (ring/flash/einsum)
+            impl=_row_impl(ff, op, choice),
             # priced terms are PER-CHIP SHARDED schedule durations;
             # measured fwd/bwd are WHOLE-OP unsharded profile seconds —
             # work_div is the strategy's split so consumers can compare

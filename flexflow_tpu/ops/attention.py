@@ -105,6 +105,16 @@ class MultiHeadAttention(Op):
         # head/attribute parallelism axis (set by the search when it picks a
         # "head" choice) so ring attention keeps heads sharded in shard_map
         self.head_parallel = p.get("head_parallel", None)
+        # searched kernel implementation (ISSUE 15, set by apply_strategy
+        # from the "_k:<impl>" choice suffix or pinned by model.compile):
+        # "flash" forces the Pallas kernel where available, "einsum" pins
+        # the reference einsum path even when flash is available, None =
+        # availability-based auto pick (pre-kernel-search behavior).
+        # When a forced "flash" cannot run (platform/shape), forward
+        # falls back to einsum and records why in _kernel_fallback —
+        # fflint FFL209 surfaces the priced-vs-executed gap.
+        self.kernel_impl = p.get("kernel_impl", None)
+        self._kernel_fallback = None
         # batch-dim sharding (str or tuple of mesh axes under the sample2
         # 'data+model' 2-D partition), recorded by apply_strategy
         self.batch_parallel = p.get("batch_parallel", None)
@@ -184,12 +194,20 @@ class MultiHeadAttention(Op):
             o = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
                                head_axis=self.head_parallel,
                                causal=self.causal)
-        elif (dropout_rate == 0.0 and q.shape[2] == k.shape[2]):
+        elif (self.kernel_impl != "einsum"
+              and dropout_rate == 0.0 and q.shape[2] == k.shape[2]):
             from flexflow_tpu.ops.pallas_kernels import (
                 flash_attention, flash_attention_available,
                 flash_attention_sharded)
 
-            if flash_attention_available(q.shape[2], q.shape[3]):
+            available = flash_attention_available(q.shape[2], q.shape[3])
+            if self.kernel_impl == "flash" and not available:
+                # the search chose flash but this platform/shape cannot
+                # run it: record the silent fallback for fflint FFL209
+                self._kernel_fallback = (
+                    f"flash unavailable at runtime (seq={q.shape[2]}, "
+                    f"head_dim={q.shape[3]}) — einsum executed instead")
+            if available:
                 if any(s > 1 for s in mesh_axes.values()):
                     # non-trivial mesh: the raw pallas_call would be an
                     # unpartitionable custom call under GSPMD — run it
@@ -221,6 +239,15 @@ class MultiHeadAttention(Op):
                     q, k, v, causal=self.causal, dropout_rate=0.0,
                     rng=None, compute_dtype=cd)
         else:
+            if self.kernel_impl == "flash" and self._kernel_fallback is None:
+                # forced flash but this forward cannot take the flash
+                # branch at all (attention-prob dropout in training, or
+                # cross-attention) — record the silent fallback so
+                # fflint FFL209 surfaces the priced-vs-executed gap
+                self._kernel_fallback = (
+                    f"flash has no lowering for this forward "
+                    f"(dropout_rate={dropout_rate}, Sq={q.shape[2]}, "
+                    f"Sk={k.shape[2]}) — einsum executed instead")
             o = scaled_dot_product_attention(
                 q, k, v, causal=self.causal, dropout_rate=dropout_rate,
                 rng=rng, compute_dtype=cd,
@@ -230,6 +257,27 @@ class MultiHeadAttention(Op):
         if self.use_bias:
             y = y + params["bo"]
         return [y.astype(query.dtype)]
+
+    def selected_impl(self, mesh_axes=None, training: bool = False) -> str:
+        """Which attention kernel ``forward`` will execute on THIS
+        platform ('ring' | 'flash' | 'einsum') — a static derivation of
+        forward's dispatch, recorded by serve observability and checked
+        by fflint so provenance never re-derives (and disagrees with)
+        the executed path. The KV-cache ``decode_forward`` is always the
+        cached einsum — flash has no incremental decomposition there."""
+        from flexflow_tpu.ops.pallas_kernels import (
+            flash_attention_available)
+
+        mesh_axes = mesh_axes or {}
+        if self.seq_parallel and mesh_axes.get(self.seq_parallel, 1) > 1:
+            return "ring"
+        if self.kernel_impl == "einsum" or (training and self.dropout > 0):
+            return "einsum"
+        b, s, e = self.input_shapes[0]
+        sk = self.input_shapes[1][1] if len(self.input_shapes) > 1 else s
+        if s == sk and flash_attention_available(s, self.head_dim):
+            return "flash"
+        return "einsum"
 
     def decode_forward(self, params, inputs, ctx: OpContext,
                        k_cache, v_cache, pos):

@@ -134,6 +134,21 @@ def apply_strategy(nodes, strategy: Strategy, mesh) -> None:
             # ("_wus" may trail any choice name — weight-update sharding
             # composes with every base choice, so match by substring)
             choice = getattr(st, "choice", None) or ""
+            # a searched "_k:<impl>" kernel suffix records WHICH KERNEL
+            # runs the op (ISSUE 15): attention ops carry it as
+            # kernel_impl (forward honors it — "flash" forces the Pallas
+            # kernel where available, "einsum" pins the reference path);
+            # "fused"/"conv_bn_fused" are executor-level choices routed
+            # via GraphExecutor.kernel_choices
+            if "_k:" in choice and hasattr(node.op, "seq_parallel"):
+                from flexflow_tpu.search.unity import kernel_choice_of
+                impl = kernel_choice_of(choice)
+                if impl in ("flash", "einsum"):
+                    # model.compile clears this again when the kernel
+                    # dimension is switched off (--kernel-search off /
+                    # FFS_NO_KERNEL_SEARCH): the off switch promises
+                    # availability-based defaults
+                    node.op.kernel_impl = impl
             if hasattr(node.op, "seq_parallel"):
                 if "_ring" in choice and axis_sizes.get("seq", 1) > 1:
                     node.op.seq_parallel = "seq"

@@ -34,7 +34,7 @@ def _node_attrs(op) -> Dict[str, Any]:
     attrs = {}
     for k in ("num_heads", "num_kv_heads", "groups", "axis", "out_dim",
               "k", "n", "n_experts", "hidden_size", "alpha",
-              "out_channels"):
+              "out_channels", "dropout"):
         v = getattr(op, k, None)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             attrs[k] = v
@@ -87,9 +87,51 @@ def _node_attrs(op) -> Dict[str, Any]:
     return attrs
 
 
-def serialize_graph(nodes) -> List[Dict[str, Any]]:
+def kernel_choice_of(choice: Optional[str]) -> Optional[str]:
+    """Kernel impl a choice name selects (the ``_k:<impl>`` suffix of
+    the suffix lattice, ISSUE 15), or None for the default lowering."""
+    if not choice or "_k:" not in choice:
+        return None
+    return choice.split("_k:", 1)[1]
+
+
+def executed_kernel_choices(nodes, strategy, mesh_axes,
+                            training: bool = False) -> Dict[str, str]:
+    """{op name -> kernel impl} a node list will EXECUTE: explicit
+    ``_k:`` suffixes from the strategy win; attention ops without one
+    report their static dispatch (``selected_impl`` — ring/flash/einsum
+    on this platform at these shapes). The ONE extraction the serve
+    bucket reports and the bench provenance column share, so the
+    recorded impls cannot drift between surfaces."""
+    out: Dict[str, str] = {}
+    for node in nodes:
+        st = (strategy or {}).get(node.op.guid)
+        impl = kernel_choice_of(getattr(st, "choice", None))
+        if impl is not None:
+            out[node.op.name] = impl
+        elif hasattr(node.op, "selected_impl"):
+            try:
+                out[node.op.name] = node.op.selected_impl(
+                    mesh_axes, training=training)
+            except Exception:
+                pass
+    return out
+
+
+def serialize_graph(nodes, final_guid: Optional[int] = None
+                    ) -> List[Dict[str, Any]]:
+    from flexflow_tpu.layout import train_fusable_conv_guids
     from flexflow_tpu.search.rewrite import external_input_ids
     neg_of = external_input_ids(nodes)
+    # conv guids whose sole consumer is a foldable BatchNorm — the
+    # legality the native "_k:conv_bn_fused" kernel twin gates on
+    # (shipped as a node attr: the gate is a GRAPH property the native
+    # per-node enumeration cannot re-derive). ``final_guid`` excludes
+    # the designated model output exactly as the executor's
+    # fuse_conv_bn_train does — the search must never price a fusion
+    # the executor refuses.
+    bn_fusable = train_fusable_conv_guids(
+        nodes, keep_guids=() if final_guid is None else {final_guid})
     out = []
     for node in nodes:
         op = node.op
@@ -101,6 +143,9 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
                    # substitution patterns can bind distinct externals
                 inputs.append([neg_of[tuple(ref)], 0])
         roles = [[r.value for r in rr] for rr in op.output_dim_roles()]
+        attrs = _node_attrs(op)
+        if op.guid in bn_fusable:
+            attrs["bn_fusable"] = 1
         out.append(dict(
             guid=op.guid,
             type=op.op_type.name,
@@ -112,7 +157,7 @@ def serialize_graph(nodes) -> List[Dict[str, Any]]:
             params=_param_shapes(op),
             flops=float(op.flops()),
             dtype_size=op.dtype.size,
-            attrs=_node_attrs(op),
+            attrs=attrs,
         ))
     return out
 
@@ -291,11 +336,16 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
     # classes never intersect the graph's op types prices nothing here
     # (everything stays analytic), and claiming "learned" would both
     # misreport and suppress fflint's all-analytic FFL701 warning
+    graph_types = {n.op.op_type.name for n in nodes}
     learned_classes = sorted(
-        set((learned or {}).get("classes") or ())
-        & {n.op.op_type.name for n in nodes})
+        c for c in set((learned or {}).get("classes") or ())
+        # per-impl classes ("TYPE:impl", the searched kernel dimension)
+        # cover a graph exactly when their base type appears in it
+        if c.split(":", 1)[0] in graph_types)
     request = dict(
-        nodes=serialize_graph(nodes),
+        nodes=serialize_graph(
+            nodes,
+            final_guid=final_ref[0] if final_ref is not None else None),
         machine=machine_to_json(machine_spec, num_devices,
                                 comm_bytes_factor=comm_factor,
                                 learned=learned),
@@ -345,6 +395,16 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             # hidden under remaining backward compute (ffs_strategy.hpp)
             comm_overlap=("off" if str(getattr(
                 config, "overlap_bucket_mb", "auto")).lower() in ("0", "off")
+                else "auto"),
+            # kernel-implementation choice as a searched dimension
+            # (ISSUE 15): "auto" enumerates the "_k:<impl>" twins
+            # (flash attention / fused optimizer update / train-time
+            # Conv+BN); "off" or FFS_NO_KERNEL_SEARCH removes the
+            # dimension — searches then reproduce pre-kernel-search
+            # results bit-identically
+            kernel_search=("off" if (
+                str(getattr(config, "kernel_search", "auto")).lower()
+                == "off" or os.environ.get("FFS_NO_KERNEL_SEARCH"))
                 else "auto"),
             # search provenance: per-mesh candidates + rejection reasons,
             # frontier-DP evolution, per-op candidate cost table

@@ -159,12 +159,18 @@ def simulate_strategy(ff, learned: Any = "auto") -> Dict[str, Any]:
             choice = _infer_choice(node, st)
         # replay what the executor EXECUTES, not what the DP picked: the
         # executor honors per-op "_wus" choices when the search supplied
-        # them (wus_ops) and applies WUS globally otherwise, and the
+        # them (wus_ops) and applies WUS globally otherwise, the
         # bucketed-async overlap structuring ("_ovl") is an executor
-        # property — so the suffixes are normalized to the runtime
-        # state. The native side falls back along the suffix lattice
-        # when an op spawns no matching twin.
+        # property, and the "_k:<impl>" kernel suffix survives exactly
+        # when the executor's kernel_choices will run that impl — so the
+        # suffixes are normalized to the runtime state (canonical order
+        # base[_wus][_ovl][_k:impl]). The native side falls back along
+        # the suffix lattice when an op spawns no matching twin.
         base = choice
+        ksfx = ""
+        if "_k:" in base:
+            base, _, kimpl = base.partition("_k:")
+            ksfx = "_k:" + kimpl
         for sfx in ("_ovl", "_wus"):
             base = base.replace(sfx, "")
         choice = base
@@ -174,10 +180,14 @@ def simulate_strategy(ff, learned: Any = "auto") -> Dict[str, Any]:
             choice += "_wus"
             if ovl_on:
                 choice += "_ovl"
+        kc = getattr(ff.executor, "kernel_choices", None) or {}
+        if ksfx and kc.get(node.op.name) == ksfx[3:]:
+            choice += ksfx
         assignment[str(node.op.guid)] = choice
     axes = dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape))
     req = dict(
-        nodes=serialize_graph(nodes),
+        nodes=serialize_graph(nodes,
+                              final_guid=ff.executor.final_ref[0]),
         machine=machine_to_json(ff.machine_spec, ff.mesh.devices.size,
                                 learned=learned),
         config=dict(training=True, overlap=True,

@@ -109,6 +109,12 @@ class BucketExecutor:
     mesh_axes: Dict[str, int]
     predicted_latency_s: Optional[float] = None
     strategy_differs: bool = False  # vs the model's training strategy
+    # per-op kernel implementations THIS bucket executes ({op name ->
+    # impl}): "_k:" choices from the bucket's searched strategy plus
+    # each attention op's statically-derived dispatch (selected_impl) —
+    # RECORDED at build time, never re-derived at report time, so serve
+    # observability and training provenance agree (ISSUE 15 defect fix)
+    kernel_choices: Dict[str, str] = dataclasses.field(default_factory=dict)
     _fwd: Any = None
 
     def forward(self):
@@ -232,10 +238,18 @@ class ServingEngine:
             fold_conv_bn=full.fold_conv_bn)
         ex.comp_mode = CompMode.INFERENCE
         axes_now = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # record the kernel each op will RUN in this bucket: explicit
+        # "_k:" searched choices, plus attention ops' static dispatch
+        # (apply_strategy already pinned kernel_impl from the choice) —
+        # the impl is decided here, at build time, with the bucket's
+        # shapes; the report only replays the record
+        from flexflow_tpu.search.unity import executed_kernel_choices
+        kernel_choices = executed_kernel_choices(nodes, strategy, axes_now)
         be = BucketExecutor(bucket=bucket, executor=ex, objective=objective,
                             mesh_axes=axes_now,
                             predicted_latency_s=predicted,
-                            strategy_differs=differs)
+                            strategy_differs=differs,
+                            kernel_choices=kernel_choices)
         reg = get_registry()
         if predicted is not None:
             reg.gauge(f"serve/bucket{bucket}/predicted_latency_s", predicted)
@@ -406,6 +420,11 @@ class ServingEngine:
         return {
             str(b): dict(objective=be.objective, mesh=be.mesh_axes,
                          predicted_latency_s=be.predicted_latency_s,
-                         strategy_differs_from_training=be.strategy_differs)
+                         strategy_differs_from_training=be.strategy_differs,
+                         # recorded at bucket build (never re-derived):
+                         # the kernel each op executes in this bucket —
+                         # training provenance (strategy "_k:" choices)
+                         # and serve observability agree by construction
+                         kernel_choices=dict(be.kernel_choices))
             for b, be in self.buckets.items()
         }

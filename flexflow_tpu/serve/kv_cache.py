@@ -164,6 +164,23 @@ class DecodeSession:
         self.caches = init_kv_cache(ff, self.batch, self.max_len)
         self.pos = 0
         self._steps: Dict[int, Any] = {}  # block length -> jitted step
+        # attention kernel provenance (ISSUE 15 defect fix): the decode
+        # path runs ``decode_forward`` — ALWAYS the cached einsum; flash
+        # has no incremental decomposition over a KV cache, so the
+        # module-level flash availability check is irrelevant here. The
+        # impl is RECORDED at session build and the report replays it,
+        # instead of re-deriving availability at report time and
+        # claiming a kernel this path can never run.
+        self.kernel_choices = {
+            n.op.name: "cached_einsum" for n in _attention_nodes(ff)}
+
+    def report(self) -> Dict[str, Any]:
+        """Session provenance for serve observability: the recorded
+        per-op attention impls (always ``cached_einsum`` on the decode
+        path) plus geometry — agrees with training provenance by
+        construction, never by re-derivation."""
+        return dict(batch=self.batch, max_len=self.max_len, pos=self.pos,
+                    kernel_choices=dict(self.kernel_choices))
 
     # ---- step construction -------------------------------------------------
     def _make_step(self, t: int):

@@ -128,6 +128,10 @@ def load_for_serving(manifest_dir: str, ff, *,
         saved_objective=(manifest.get("strategy") or {}).get("objective"),
         objective=getattr(ff, "search_objective", None),
         cross_mesh=not elastic.strategy_matches_mesh(manifest, ff.mesh),
+        # per-op kernel choices the deployed model executes (the "_k:"
+        # dimension replayed from the searched/recorded strategy) — the
+        # serving twin of the training-side provenance
+        kernel_choices=getattr(ff, "kernel_choices", None),
     )
     if os.environ.get("FFS_SERVE_VERBOSE"):
         print(f"[serve] load_for_serving: {ff.serve_load_info}",

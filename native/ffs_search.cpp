@@ -70,6 +70,9 @@ struct SearchConfig {
                                     // (--weight-update-sharding != off)
   bool enable_overlap = true;       // comms-compute-overlap "_ovl" choice
                                     // variants (--overlap-bucket-mb != 0)
+  bool enable_kernels = true;       // kernel-implementation "_k:<impl>"
+                                    // choice twins (--kernel-search !=
+                                    // off / FFS_NO_KERNEL_SEARCH unset)
   bool emit_trace = false;          // structured search-trace emission
                                     // (search provenance; explain.py /
                                     // obs .searchtrace.json artifact)
@@ -106,6 +109,11 @@ struct SearchConfig {
     // "auto"/"on"/explicit-bucket enumerate the "_ovl" latency-hiding
     // twins (the DP picks per op); "off" removes the dimension
     c.enable_overlap = j.get("comm_overlap").as_string() != "off";
+    // "auto" enumerates the "_k:<impl>" kernel twins (flash attention,
+    // fused optimizer update, train-time Conv+BN — ffs_strategy.hpp);
+    // "off" removes the dimension entirely (FFS_NO_KERNEL_SEARCH's
+    // bit-identical pre-kernel-search escape hatch)
+    c.enable_kernels = j.get("kernel_search").as_string() != "off";
     c.emit_trace = j.get("emit_search_trace").as_bool(false);
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
@@ -142,7 +150,16 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                 cfg.enable_wus && cfg.training,
                                 // "_ovl" latency-hiding twins: only
                                 // meaningful in training (gradient sync)
-                                cfg.enable_overlap && cfg.training);
+                                cfg.enable_overlap && cfg.training,
+                                // "_k:<impl>" kernel twins (flash applies
+                                // at inference too; fused/conv_bn_fused
+                                // gate on `training` inside). Not on pipe
+                                // meshes: the pipeline executor has no
+                                // per-op kernel plumbing yet — pricing a
+                                // lowering it cannot deliver would
+                                // misrank strategies (the _ovl lesson).
+                                cfg.enable_kernels && mesh.pp == 1,
+                                cfg.training);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -792,6 +809,15 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
   cj.set("choice", Json(c.name));
   cj.set("chosen", Json(chosen));
   cj.set("work_div", Json(c.work_div));
+  // which kernel implementation this candidate lowers to ("einsum" /
+  // "flash" / "ring" / "conv" / "conv_bn_fused" / "triad" / "fused") —
+  // the searched-kernel provenance column (ISSUE 15). Ops with no
+  // registered alternatives carry no impl.
+  {
+    std::string impl = c.kernel.empty() ? kernel_default_impl(n, c)
+                                        : c.kernel;
+    if (!impl.empty()) cj.set("impl", Json(impl));
+  }
   // which model priced this candidate's compute (learned vs analytic
   // vs measured) — the per-candidate provenance the costmodel loop
   // audits (ISSUE 14)
@@ -844,13 +870,8 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
       sync_c.ovl = false;
       NodeCost base_sync = node_cost(n, sync_c, mesh, m, cfg.training,
                                      measured);
-      double hide = base_sync.bwd;
-      if (n.param_bytes() > 0) {
-        double upd = detail::sharded_param_bytes(n, c, mesh) *
-                     (3.0 + 2.0 * cfg.opt_state_factor) / m.hbm_bw;
-        if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
-        hide += upd;
-      }
+      double hide = base_sync.bwd +
+                    update_triad_time(n, c, mesh, m, cfg.opt_state_factor);
       double wire = c.gradsync_bytes * m.comm_bytes_factor;
       for (int bi = 0; bi < kOvlBucketCount; ++bi) {
         double mb = kOvlBucketMB[bi];
@@ -908,6 +929,24 @@ Json per_op_trace(const Graph& g,
       for (int64_t d : n.output_shapes[0]) shp.push_back(Json(d));
     oj.set("out_shape", shp);
     oj.set("chosen", Json(choices[i][assign[i]].name));
+    // kernel alternatives the legality gates rejected for this op, with
+    // the gate's named reason (e.g. the tiny t1 transformer's attention
+    // rejects flash with seq_not_divisible_by_flash_tile_128) — the
+    // per-op analog of the mesh rows' "illegal" class
+    if (cfg.enable_kernels) {
+      Json krej = Json::array();
+      auto note = [&](const char* impl) {
+        std::string why = kernel_gate(n, impl, cfg.training);
+        if (why.empty()) return;
+        Json r = Json::object();
+        r.set("impl", Json(std::string(impl)));
+        r.set("reason", Json(why));
+        krej.push_back(std::move(r));
+      };
+      if (n.type == "MULTIHEAD_ATTENTION") note("flash");
+      if (n.type == "CONV2D" && cfg.training) note("conv_bn_fused");
+      if (!krej.items().empty()) oj.set("kernel_rejections", krej);
+    }
     Json cands = Json::array();
     for (size_t ci = 0; ci < choices[i].size(); ++ci)
       cands.push_back(choice_trace_json(n, choices[i][ci], mesh, m, cfg,
@@ -1394,34 +1433,49 @@ Json simulate_only(const Json& req) {
     };
     const Choice* pick = find(want);
     if (pick == nullptr) {
-      // suffix fallback both ways for the "_wus"/"_ovl" twins: a
+      // suffix fallback both ways for the "_wus"/"_ovl"/"_k:" twins: a
       // heuristic replay may ask for a twin an op doesn't spawn (no
       // gradsync), and a stale strategy file may lack the suffixes an
-      // enabled run expects. Canonical order is base[+_wus][+_ovl].
-      // Candidates walk the suffix lattice nearest the REQUESTED
-      // suffixes first, toggling "_ovl" (a pure latency-hiding pricing
-      // delta) before "_wus" (which also moves optimizer-state memory
-      // and the update triad) — so e.g. a plain "dp_ovl" request never
-      // silently picks up WUS pricing while "dp" is available.
+      // enabled run expects. Canonical order is base[+_wus][+_ovl]
+      // [+_k:impl]. Candidates walk the suffix lattice nearest the
+      // REQUESTED suffixes first: keep the "_k:" kernel suffix where a
+      // twin carries it, then drop it (a kernel-search-off replay of a
+      // kernel-searched strategy prices the default lowering), toggling
+      // "_ovl" (a pure latency-hiding pricing delta) before "_wus"
+      // (which also moves optimizer-state memory and the update triad)
+      // — so e.g. a plain "dp_ovl" request never silently picks up WUS
+      // pricing while "dp" is available.
       auto strip = [](std::string s, const char* sfx) {
         size_t n = strlen(sfx);
         if (s.size() > n && s.compare(s.size() - n, n, sfx) == 0)
           s.erase(s.size() - n);
         return s;
       };
-      std::string base = strip(strip(want, "_ovl"), "_wus");
+      std::string ksuffix;
+      std::string base = want;
+      size_t kp = base.find("_k:");
+      if (kp != std::string::npos) {
+        ksuffix = base.substr(kp);
+        base.erase(kp);
+      }
+      base = strip(strip(base, "_ovl"), "_wus");
       const bool has_wus = want.find("_wus") != std::string::npos;
       const bool has_ovl = want.find("_ovl") != std::string::npos;
       auto name_of = [&](bool w, bool o) {
         return base + (w ? "_wus" : "") + (o ? "_ovl" : "");
       };
-      const std::string cands[] = {name_of(has_wus, !has_ovl),
-                                   name_of(!has_wus, has_ovl),
-                                   name_of(!has_wus, !has_ovl)};
-      for (const std::string& cand : cands) {
-        if (cand == want) continue;
-        pick = find(cand);
+      const std::string lattice[] = {name_of(has_wus, has_ovl),
+                                     name_of(has_wus, !has_ovl),
+                                     name_of(!has_wus, has_ovl),
+                                     name_of(!has_wus, !has_ovl)};
+      for (const std::string& ln : lattice) {
         if (pick != nullptr) break;
+        for (const std::string& cand :
+             {ln + ksuffix, ln}) {
+          if (cand == want) continue;
+          pick = find(cand);
+          if (pick != nullptr) break;
+        }
       }
     }
     if (pick == nullptr)

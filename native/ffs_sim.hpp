@@ -272,19 +272,32 @@ class TaskgraphSimulator {
         auto it = measured_->find("__update_bw__");
         if (it != measured_->end() && it->second > 0) upd_bw = it->second;
       }
-      double upd_bytes = 0;
+      double upd_bytes = 0, upd_saved = 0;
       for (size_t i = 0; i < N; ++i) {
         // WUS: the update triad runs on the per-chip shard only —
-        // optimizer HBM traffic divides by the gradient-ring size
+        // optimizer HBM traffic divides by the gradient-ring size.
+        // "_k:fused" choices price the one-dispatch fused region: one
+        // param round trip fewer and two launches saved, CAPPED at the
+        // node's own update time (mirrors update_triad_time's per-node
+        // floor, ffs_strategy.hpp — a tiny fused op must not let its
+        // launch saving eat into other ops' update traffic, or the
+        // replay would price fused cheaper than the DP did).
         const Choice& c = assign[i];
         double div = (c.wus && c.gradsync_k > 1) ? (double)c.gradsync_k
                                                  : 1.0;
-        upd_bytes += (double)g_.nodes[i].param_bytes() *
-                     (3.0 + 2.0 * opt_state_factor_) / div;
+        double copies = (c.kernel == "fused") ? 2.0 : 3.0;
+        double nb = (double)g_.nodes[i].param_bytes() *
+                    (copies + 2.0 * opt_state_factor_) / div;
+        upd_bytes += nb;
+        if (c.kernel == "fused" && g_.nodes[i].param_bytes() > 0)
+          upd_saved += std::min(2.0 * m_.collective_launch_overhead,
+                                nb / upd_bw);
       }
       std::vector<int> deps = sync_ids;
       if (last_bwd >= 0) deps.push_back(last_bwd);
-      SimTask ut{SimTask::Kind::Update, -1, upd_bytes / upd_bw, deps, "", 0};
+      SimTask ut{SimTask::Kind::Update, -1,
+                 std::max(0.0, upd_bytes / upd_bw - upd_saved), deps, "",
+                 0};
       add(std::move(ut));
     }
 
@@ -409,7 +422,15 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   int body_ops = 0;
   int gradsync_k = mesh.dp;
   double ht_time = 0, ht_param_mem = 0, ht_act = 0, ht_gradsync = 0;
-  double upd_bytes = 0;
+  double upd_bytes = 0, upd_saved = 0;
+  // update-triad bandwidth (measured override when profiled) — hoisted
+  // above the node loop so the per-node fused launch-saving cap below
+  // can price each node's own update time
+  double upd_bw = m.hbm_bw;
+  if (measured != nullptr) {
+    auto it = measured->find("__update_bw__");
+    if (it != measured->end() && it->second > 0) upd_bw = it->second;
+  }
   MeshShape inner = mesh;
   inner.pp = 1;
   const int spans = slices_spanned(inner, m);
@@ -471,11 +492,18 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
     }
     if (training && n.param_bytes() > 0) {
       // optimizer update-triad HBM traffic: stage weights already /pp;
-      // WUS additionally divides by the gradient ring
+      // WUS additionally divides by the gradient ring; "_k:fused"
+      // choices price the one-dispatch fused region with the launch
+      // saving capped at the node's own update time (update_triad_time)
       double div = (c.wus && c.gradsync_k > 1) ? (double)c.gradsync_k : 1.0;
-      upd_bytes += detail::sharded_param_bytes(n, c, inner) /
-                   (body ? (double)pp : 1.0) *
-                   (3.0 + 2.0 * opt_state_factor) / div;
+      double copies = (c.kernel == "fused") ? 2.0 : 3.0;
+      double nb = detail::sharded_param_bytes(n, c, inner) /
+                  (body ? (double)pp : 1.0) *
+                  (copies + 2.0 * opt_state_factor) / div;
+      upd_bytes += nb;
+      if (c.kernel == "fused")
+        upd_saved += std::min(2.0 * m.collective_launch_overhead,
+                              nb / upd_bw);
     }
     // per-op collective census records (durations already in nc.comm)
     double psum_total = (training ? 2.0 : 1.0) * c.psum_bytes +
@@ -534,12 +562,7 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   if (training) {
     res.bwd_time = ticks * (tick_bwd + hop);
     res.iteration_time += res.bwd_time;
-    double upd_bw = m.hbm_bw;
-    if (measured != nullptr) {
-      auto it = measured->find("__update_bw__");
-      if (it != measured->end() && it->second > 0) upd_bw = it->second;
-    }
-    double upd_time = upd_bytes / upd_bw;
+    double upd_time = std::max(0.0, upd_bytes / upd_bw - upd_saved);
     if (mesh.dp > 1 && body_gs_plain > 0) {
       double t = m.hier_allreduce_time(body_gs_plain / pp, gradsync_k,
                                        spans, kData);

@@ -137,7 +137,78 @@ struct Choice {
   int ring_k = 1;                      // seq-ring size (hop count = ring_k-1)
   double gather_bytes = 0.0;           // all-gather a parallel-op boundary
   int gather_k = 1;                    // (Combine) forces
+  std::string kernel;                  // searched kernel implementation
+                                       // ("" = the op's default lowering;
+                                       // "flash" / "fused" /
+                                       // "conv_bn_fused" for the "_k:"
+                                       // choice twins — ISSUE 15)
 };
+
+// ---- kernel-implementation dimension ("_k:<impl>" twins) -------------------
+//
+// The search decides HOW TO SHARD every op but, until this dimension,
+// not WHICH KERNEL runs it. Ops with registered kernel alternatives
+// spawn "_k:<impl>" twins of every sharding choice (composing with the
+// "_wus"/"_ovl" suffix lattice — canonical order base[_wus][_ovl][_k:i]),
+// each priced per-impl: measured "<guid>:fwd:<impl>" rows override a
+// learned "<TYPE>:<impl>" class which overrides the analytic
+// HBM-traffic delta vs the default lowering. FlexFlow/Unity's joint
+// algorithmic+parallelization optimization (substitution.cc:2229)
+// expressed on the suffix lattice.
+
+// Default kernel impl of (node, choice) — what executes when no "_k:"
+// twin is chosen. Attention's ring impl is carried by the existing
+// "_ring" seq-sharding suffix (ring is exactly the seq-sharded
+// execution, so its legality gate IS the seq mesh), not a "_k:" twin.
+inline const char* kernel_default_impl(const Node& n, const Choice& c) {
+  if (n.type == "MULTIHEAD_ATTENTION")
+    return c.name.find("_ring") != std::string::npos ? "ring" : "einsum";
+  if (n.type == "CONV2D") return "conv";
+  if (c.wus) return "triad";
+  return "";
+}
+
+// Structural legality of a kernel alternative on `n`: "" = legal, else a
+// named rejection reason recorded in the search trace (the flash gate
+// mirrors ops/pallas_kernels.flash_attention_available — Q-block tile
+// divisibility and lane-aligned head dim; conv_bn_fused mirrors the
+// layout.py fold eligibility shipped as the `bn_fusable` node attr).
+inline std::string kernel_gate(const Node& n, const std::string& impl,
+                               bool training = true) {
+  if (impl == "flash") {
+    if (n.type != "MULTIHEAD_ATTENTION") return "not_attention";
+    int64_t heads = n.attrs.get("num_heads").as_int(0);
+    const Shape& os = n.output_shapes.empty() ? Shape{} : n.output_shapes[0];
+    if (os.size() < 3 || heads <= 0) return "no_attention_geometry";
+    int64_t seq = os[1];
+    int64_t head_dim = os.back() / heads;
+    for (const Shape& is : n.input_shapes)
+      if ((int64_t)is.size() < 2 || is[1] != seq)
+        return "not_self_attention";
+    if (seq % 128) return "seq_not_divisible_by_flash_tile_128";
+    if (head_dim % 8) return "head_dim_not_lane_aligned_8";
+    // attention-prob dropout has no flash lowering (the kernel never
+    // materializes the probabilities to drop) — training forwards take
+    // the einsum path, so pricing flash would be a priced-vs-executed
+    // gap; at inference dropout is off and flash stays legal
+    if (training && n.attrs.get("dropout").as_double(0.0) > 0.0)
+      return "attention_prob_dropout_unsupported";
+    return "";
+  }
+  if (impl == "fused") {
+    // fused optimizer-update region: collapses the WUS
+    // RS -> update-triad -> AG chain into one dispatch
+    if (n.param_bytes() <= 0) return "no_parameters";
+    return "";
+  }
+  if (impl == "conv_bn_fused") {
+    if (n.type != "CONV2D") return "not_conv";
+    if (n.attrs.get("bn_fusable").as_int(0) == 0)
+      return "no_foldable_batchnorm_consumer";
+    return "";
+  }
+  return "unknown_impl";
+}
 
 // ---- latency-hiding (comms-compute overlap) pricing -----------------------
 
@@ -273,7 +344,9 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
                                              bool enable_pp,
                                              bool enable_sp2 = true,
                                              bool enable_wus = false,
-                                             bool enable_ovl = false) {
+                                             bool enable_ovl = false,
+                                             bool enable_kernels = false,
+                                             bool training = true) {
   using detail::div_ok;
   using detail::dp_spec;
   const int dp = mesh.dp, mp = mesh.mp;
@@ -766,6 +839,52 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
       out.push_back(std::move(c));
     }
   }
+
+  // ---- kernel-implementation ("_k:<impl>") variants ------------------------
+  // Runs LAST so the kernel suffix composes with every sharding/"_wus"/
+  // "_ovl" twin already enumerated (canonical base[_wus][_ovl][_k:impl]).
+  // Each twin is a different LOWERING of the same sharded computation:
+  // identical specs and collectives, different compute/update pricing
+  // (node_cost's per-impl chain). Legality gates fire here; their named
+  // reasons are re-derived into the search trace by per_op_trace.
+  if (enable_kernels) {
+    const size_t base_count = out.size();
+    for (size_t bi = 0; bi < base_count; ++bi) {
+      // by VALUE: the push_backs below may reallocate `out`, and a
+      // reference into it would dangle across the checks that follow
+      const Choice b = out[bi];
+      // flash attention: streams K/V through VMEM per Q block — no
+      // materialized [B,H,S,S] score tensor in HBM. Not on "_ring"
+      // parents: ring attention IS its own kernel (impl "ring").
+      if (t == "MULTIHEAD_ATTENTION" &&
+          b.name.find("_ring") == std::string::npos &&
+          kernel_gate(n, "flash", training).empty()) {
+        Choice c = b;
+        c.name += "_k:flash";
+        c.kernel = "flash";
+        out.push_back(std::move(c));
+      }
+      // train-time Conv+BN fused region (the eval fold's legality,
+      // shipped as the bn_fusable attr, reused at train time)
+      if (t == "CONV2D" && training &&
+          kernel_gate(n, "conv_bn_fused").empty()) {
+        Choice c = b;
+        c.name += "_k:conv_bn_fused";
+        c.kernel = "conv_bn_fused";
+        out.push_back(std::move(c));
+      }
+      // fused optimizer update: the WUS RS -> triad -> AG chain
+      // collapses from three dispatches to one fused region. Attention
+      // keeps its "_k:" dimension for the attention core.
+      if (training && b.wus && t != "MULTIHEAD_ATTENTION" &&
+          kernel_gate(n, "fused").empty()) {
+        Choice c = b;
+        c.name += "_k:fused";
+        c.kernel = "fused";
+        out.push_back(std::move(c));
+      }
+    }
+  }
   return out;
 }
 
@@ -808,11 +927,42 @@ inline void learned_features(const Node& n, const Choice& c,
 // node_cost and the search trace's learned-vs-analytic columns.
 inline bool learned_compute(const Node& n, const Choice& c,
                             const MachineModel& m, double* fwd,
-                            double* bwd) {
+                            double* bwd, bool* matched_impl = nullptr) {
+  if (matched_impl != nullptr) *matched_impl = false;
   if (m.learned.empty()) return false;
   double f[kLearnedFeatures];
   learned_features(n, c, f);
+  // compute-kernel twins prefer their per-impl class ("TYPE:impl",
+  // trained on per-impl corpus rows); base class is the fallback —
+  // `matched_impl` reports which matched, so node_cost knows whether
+  // the analytic per-impl delta still applies on top
+  if (!c.kernel.empty() && c.kernel != "fused" &&
+      m.learned_predict(n.type + ":" + c.kernel, f, fwd, bwd)) {
+    if (matched_impl != nullptr) *matched_impl = true;
+    return true;
+  }
   return m.learned_predict(n.type, f, fwd, bwd);
+}
+
+// Optimizer update-triad HBM time of (node, choice): read p + read g +
+// write p (3x the shard's param bytes) + read+write per optimizer-state
+// copy; WUS divides by the gradient ring. The "_k:fused" kernel twin
+// collapses the RS-epilogue / per-leaf update kernels / AG-prologue
+// chain into ONE fused region: the separate update kernels' re-read of
+// p between dispatches disappears (3 -> 2 param round trips) and two of
+// the three dispatch launches are saved. Shared by node_cost's hide
+// window and its final update term so both price the same triad.
+inline double update_triad_time(const Node& n, const Choice& c,
+                                const MeshShape& mesh, const MachineModel& m,
+                                double opt_state_factor) {
+  if (opt_state_factor < 0 || n.param_bytes() <= 0) return 0.0;
+  double copies = (c.kernel == "fused") ? 2.0 : 3.0;
+  double upd = detail::sharded_param_bytes(n, c, mesh) *
+               (copies + 2.0 * opt_state_factor) / m.hbm_bw;
+  if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
+  if (c.kernel == "fused")
+    upd = std::max(0.0, upd - 2.0 * m.collective_launch_overhead);
+  return upd;
 }
 
 // Layout-only ops XLA fuses into their producer/consumer on TPU: a slice,
@@ -845,12 +995,22 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   NodeCost nc;
   if (is_view_op(n.type)) return nc;  // fused away by XLA: free
   double div = std::max(1.0, c.work_div);
+  // kernel twins that change the COMPUTE lowering (flash,
+  // conv_bn_fused; "fused" only moves the update term): their measured
+  // rows are keyed "<guid>:fwd:<impl>" and their learned class
+  // "<TYPE>:<impl>" — the base rows/class time the DEFAULT lowering and
+  // must not silently price a different kernel
+  const bool compute_impl = !c.kernel.empty() && c.kernel != "fused";
   const double* mfwd = nullptr;
   const double* mbwd = nullptr;
   if (measured != nullptr) {
-    auto itf = measured->find(std::to_string(n.guid) + ":fwd");
+    const std::string kf = std::to_string(n.guid) + ":fwd" +
+                           (compute_impl ? ":" + c.kernel : std::string());
+    const std::string kb = std::to_string(n.guid) + ":bwd" +
+                           (compute_impl ? ":" + c.kernel : std::string());
+    auto itf = measured->find(kf);
     if (itf != measured->end()) mfwd = &itf->second;
-    auto itb = measured->find(std::to_string(n.guid) + ":bwd");
+    auto itb = measured->find(kb);
     if (itb != measured->end()) mbwd = &itb->second;
   }
   double flop = n.fwd_flops / div;
@@ -904,10 +1064,15 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
   // pricing priority: measured per-op profile > learned regression >
   // analytic roofline. The learned model predicts per-chip SHARDED
   // seconds directly (its targets were measured/work_div and work_div
-  // is a feature), so no further division applies.
+  // is a feature), so no further division applies. Kernel twins prefer
+  // a per-impl learned class; absent one, the DEFAULT lowering's price
+  // (base learned or analytic) gets the impl's analytic HBM-traffic
+  // delta applied below.
   double lfwd = 0, lbwd = 0;
+  bool learned_is_impl = false;
   bool has_learned =
-      mfwd == nullptr && learned_compute(n, c, m, &lfwd, &lbwd);
+      mfwd == nullptr &&
+      learned_compute(n, c, m, &lfwd, &lbwd, &learned_is_impl);
   if (mfwd != nullptr) {
     nc.fwd = std::max(*mfwd / div, m.min_op_time);
     nc.src = SRC_MEASURED;
@@ -924,6 +1089,36 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
       nc.bwd = std::max(lbwd, m.min_op_time);
     else
       nc.bwd = 2.0 * nc.fwd;  // dX + dW passes
+  }
+  if (compute_impl && mfwd == nullptr && !learned_is_impl) {
+    // analytic per-impl delta on the default lowering's price, floored
+    // at the pure flop bound (the impl removes HBM traffic, not math)
+    double asym = eff > 0 ? eff : m.mxu_efficiency;
+    double peak = m.flops * asym * (n.dtype_size <= 2 ? 1.0 : 0.5);
+    double floor_f = flop / peak + m.min_op_time;
+    double floor_b = 2.0 * flop / peak + m.min_op_time;
+    if (c.kernel == "flash") {
+      // HBM-traffic model vs the materialized-scores einsum: the
+      // default lowering round-trips the f32 [B,H,S,S] probability
+      // tensor (write+read fwd; recomputed probs + dP write+read bwd)
+      // — flash keeps scores in VMEM. The calibrated einsum price
+      // implicitly contains that traffic; subtract it.
+      int64_t heads = n.attrs.get("num_heads").as_int(1);
+      const Shape& os = n.output_shapes[0];
+      double score_b = (double)os[0] * heads * (double)os[1] *
+                       (double)os[1] * 4.0 / div;
+      nc.fwd = std::max(nc.fwd - 2.0 * score_b / m.hbm_bw, floor_f);
+      if (training)
+        nc.bwd = std::max(nc.bwd - 4.0 * score_b / m.hbm_bw, floor_b);
+    } else if (c.kernel == "conv_bn_fused") {
+      // fused Conv+BN region: the conv output's write + the BN's read
+      // of it never round-trip HBM, and one dispatch is saved
+      int k_out = c.out.empty() ? 1 : shards_of(c.out[0], mesh);
+      double bnd = 2.0 * (double)n.output_bytes(0) / k_out / m.hbm_bw;
+      nc.fwd = std::max(nc.fwd - bnd - m.min_op_time, floor_f);
+      if (training)
+        nc.bwd = std::max(nc.bwd - bnd, floor_b);
+    }
   }
   if (c.psum_bytes > 0 && c.psum_k > 1) {
     double t = m.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
@@ -966,13 +1161,8 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
       // (early buckets' collectives ride under the rest of backward)
       // plus, when the update-triad term is being priced, the optimizer
       // fusion tail the WUS param all-gather prefetches under.
-      double hide = nc.bwd;
-      if (opt_state_factor >= 0 && n.param_bytes() > 0) {
-        double upd = detail::sharded_param_bytes(n, c, mesh) *
-                     (3.0 + 2.0 * opt_state_factor) / m.hbm_bw;
-        if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
-        hide += upd;
-      }
+      double hide = nc.bwd +
+                    update_triad_time(n, c, mesh, m, opt_state_factor);
       OverlapPricing ov = overlap_price(
           m, sync, c.gradsync_bytes * m.comm_bytes_factor, hide);
       nc.gradsync = ov.exposed;
@@ -983,12 +1173,8 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
       nc.gradsync = sync;
     }
   }
-  if (training && opt_state_factor >= 0 && n.param_bytes() > 0) {
-    double upd = detail::sharded_param_bytes(n, c, mesh) *
-                 (3.0 + 2.0 * opt_state_factor) / m.hbm_bw;
-    if (c.wus && c.gradsync_k > 1) upd /= c.gradsync_k;
-    nc.gradsync += upd;
-  }
+  if (training)
+    nc.gradsync += update_triad_time(n, c, mesh, m, opt_state_factor);
   return nc;
 }
 

@@ -136,7 +136,8 @@ def predicted_step(ff, measured):
 
     nodes = ff.executor.nodes
     req = dict(
-        nodes=serialize_graph(nodes),
+        nodes=serialize_graph(nodes,
+                              final_guid=ff.executor.final_ref[0]),
         machine=machine_to_json(ff.machine_spec, 1),
         config=dict(training=True, overlap=True,
                     opt_state_factor=0.0),  # plain SGD: no optimizer state

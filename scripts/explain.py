@@ -115,6 +115,46 @@ def chosen_vs_runner_up(trace, top=20):
     return rows[:top], len(rows)
 
 
+def kernel_choice_rows(trace):
+    """Per-op kernel-implementation table (the searched ``_k:``
+    dimension, ISSUE 15): ops where the search priced more than one
+    kernel impl — chosen impl vs the best candidate of each OTHER impl
+    at the same sharding family — plus the legality-gate rejections
+    (e.g. flash refused on a seq the tile size doesn't divide)."""
+    rows = []
+    for op in trace.get("ops") or []:
+        cands = op.get("candidates") or []
+        impls = {c.get("impl") for c in cands if c.get("impl")}
+        rejections = op.get("kernel_rejections") or []
+        if len(impls) <= 1 and not rejections:
+            continue
+        chosen = next((c for c in cands if c.get("chosen")), None)
+        if chosen is None:
+            continue
+        best_by_impl = {}
+        for c in cands:
+            impl = c.get("impl")
+            if not impl:
+                continue
+            t = c["terms"]["total_s"]
+            if impl not in best_by_impl or t < best_by_impl[impl][1]:
+                best_by_impl[impl] = (c["choice"], t)
+        chosen_impl = chosen.get("impl") or "default"
+        alts = sorted(((i, n, t) for i, (n, t) in best_by_impl.items()
+                       if i != chosen_impl), key=lambda x: x[2])
+        rows.append(dict(
+            name=op.get("name"), type=op.get("type"),
+            chosen=chosen["choice"], chosen_impl=chosen_impl,
+            chosen_s=chosen["terms"]["total_s"],
+            cost_source=chosen.get("cost_source"),
+            alternatives=[dict(impl=i, choice=n, total_s=t)
+                          for i, n, t in alts],
+            rejections=rejections,
+        ))
+    rows.sort(key=lambda r: -r["chosen_s"])
+    return rows
+
+
 def learned_vs_analytic_disagreements(trace):
     """Ops where the learned and the analytic cost model rank a
     DIFFERENT winning choice (ISSUE 14: the disagreement is exactly
@@ -247,7 +287,7 @@ def write_sim_trace_file(trace_dir, model, sim_resp, name_of):
 
 def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
                 reasons, path_rows, path_total, merged_path,
-                disagreements=None, n_compared=0):
+                disagreements=None, n_compared=0, kernel_rows=None):
     info = ff.search_info if isinstance(ff.search_info, dict) else {}
     stats = info.get("stats") or {}
     mesh = trace.get("winner_mesh") or {}
@@ -313,6 +353,31 @@ def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
             f"{_fmt_s(r.get('runner_up_s'), 4)} | "
             f"{'-' if delta is None else f'{delta:+.1%}'} | "
             f"{' '.join(r['collectives']) or '-'} |")
+    if kernel_rows:
+        lines += [
+            "",
+            "## Kernel choices (the searched `_k:` dimension)",
+            "",
+            "Ops where the search priced more than one kernel "
+            "implementation (or a legality gate rejected one). The "
+            "chosen impl executes through the per-op kernel plumbing; "
+            "`rejected` names the gate that kept an impl out of the "
+            "candidate set.",
+            "",
+            "| op | type | chosen impl (choice) | ms | src | "
+            "best alternative | ms | rejected |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for r in kernel_rows[:20]:
+            alt = r["alternatives"][0] if r["alternatives"] else None
+            rej = "; ".join(f"{x['impl']}: {x['reason']}"
+                            for x in r["rejections"]) or "-"
+            lines.append(
+                f"| {r['name']} | {r['type']} | {r['chosen_impl']} "
+                f"(`{r['chosen']}`) | {_fmt_s(r['chosen_s'], 4)} | "
+                f"{r.get('cost_source') or '-'} | "
+                f"{alt['impl'] if alt else '-'} | "
+                f"{_fmt_s(alt['total_s'], 4) if alt else '-'} | {rej} |")
     if n_compared:
         lines += ["", "## Learned vs analytic cost model", ""]
         if disagreements:
@@ -458,6 +523,9 @@ def main():
     if n_compared:
         artifact["cost_model_disagreements"] = dict(
             ops_compared=n_compared, rows=disagreements)
+    kernel_rows = kernel_choice_rows(trace)
+    if kernel_rows:
+        artifact["kernel_choices"] = kernel_rows
     write_artifact(out_json, artifact, kind="search_trace")
 
     rows, total_ops = chosen_vs_runner_up(trace, top=args.top)
@@ -466,7 +534,7 @@ def main():
     md = to_markdown(args.model, ff, trace, sim_resp, rows, total_ops,
                      feasible, reasons, path_rows, path_total,
                      merged_path, disagreements=disagreements,
-                     n_compared=n_compared)
+                     n_compared=n_compared, kernel_rows=kernel_rows)
     out_md = os.path.join(args.out_dir, "EXPLAIN.md")
     with open(out_md, "w") as f:
         f.write(md)

@@ -64,6 +64,35 @@ sys.exit(1 if missing else 0)
 EOF
 ovl_rc=$?
 if [ "$ovl_rc" -ne 0 ]; then echo "OBS overlap fields: $(cat /tmp/_t1_ovl.out) — non-fatal"; else echo "OBS overlap fields: ok"; fi
+# Kernel-search stage (ISSUE 15, non-fatal): the explain stage's
+# SEARCH_TRACE.json must carry per-op KERNEL candidate rows — an impl
+# column (einsum/flash/triad/fused/...) with a cost_source on every
+# candidate — and EXPLAIN.md must render the kernel-choice table, so
+# the searched `_k:` dimension's provenance never silently drops out.
+timeout -k 10 60 python - > /tmp/_t1_kernel.out 2>&1 <<'EOF'
+import json, sys
+art = json.load(open("SEARCH_TRACE.json"))
+ops = (art.get("search_trace") or {}).get("ops") or []
+missing = []
+impl_rows = [c for o in ops for c in (o.get("candidates") or [])
+             if c.get("impl")]
+if not impl_rows:
+    missing.append("no candidate carries an impl column")
+if not all("cost_source" in c for o in ops
+           for c in (o.get("candidates") or [])):
+    missing.append("candidate without cost_source")
+kc = art.get("kernel_choices") or []
+if not kc:
+    missing.append("artifact carries no kernel_choices rows")
+md = open("EXPLAIN.md").read()
+if "## Kernel choices" not in md:
+    missing.append("EXPLAIN.md lacks the kernel-choice table")
+print("missing: " + ", ".join(missing) if missing
+      else f"ok ({len(impl_rows)} impl rows, {len(kc)} kernel-choice ops)")
+sys.exit(1 if missing else 0)
+EOF
+kernel_rc=$?
+if [ "$kernel_rc" -ne 0 ]; then echo "KERNEL: $(cat /tmp/_t1_kernel.out) — non-fatal"; else echo "KERNEL: $(cat /tmp/_t1_kernel.out)"; fi
 # Elasticity stage (ISSUE 10, non-fatal): the tier-1-fast kill-and-resume
 # leg — 2 processes x 1 device, a host killed mid-epoch via FFS_FAULT,
 # resume from the last complete per-shard checkpoint on the same mesh

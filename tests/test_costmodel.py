@@ -426,7 +426,7 @@ class TestSearchWiring:
         resp_an = simulate_strategy(ff, learned=False)
         assert set(resp_an["cost_sources"].values()) == {"analytic"}
         report = simtrace_report(ff, resp, resp_analytic=resp_an)
-        assert report["corpus_schema"] == 2
+        assert report["corpus_schema"] == 3
         assert report["cost_sources"].get("learned", 0) >= 1
         assert report["predicted_analytic"]["step_s"] == \
             resp_an["iteration_time"]
