@@ -296,12 +296,58 @@ def build_pipeline_transformer(on_cpu):
     return ff, [x], y, out_cfg
 
 
+def build_multislice_transformer(on_cpu):
+    """Multi-slice transformer (2 slices x 4 chips), deviceless on CPU:
+    the 8 virtual host devices stand in for two DCN-connected slices.
+    ``--slices 2`` splits the flat data mesh into ('slice', 'data') in
+    model.compile, so the gradient sync crosses the slice boundary and
+    the fabric-split census (collectives_by_fabric) attributes its bytes
+    to DCN — the ``dcn_bytes`` coordinate this workload records. On a
+    real multi-slice deployment the physical DCN carries the same
+    collectives; here the numbers are compile-determined, not timed."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.machine import make_mesh
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 create_transformer)
+    from flexflow_tpu.optimizers import AdamOptimizer
+
+    ndev = len(jax.devices())
+    if ndev < 8:
+        raise RuntimeError(
+            f"multislice workload needs >= 8 devices, have {ndev}")
+    cfg = (TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                             seq_length=64, batch_size=32)
+           if on_cpu else
+           TransformerConfig(num_layers=8, hidden_size=1024, num_heads=16,
+                             seq_length=512, batch_size=64))
+    c = FFConfig(batch_size=cfg.batch_size)
+    c.slices = 2
+    ff = create_transformer(cfg, c)
+    ff.compile(AdamOptimizer(alpha=1e-4, state_dtype=jnp.bfloat16),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               mesh=make_mesh(8, {"data": 8}))
+    assert "slice" in ff.mesh.axis_names, ff.mesh.axis_names
+    rs = np.random.RandomState(0)
+    x = rs.randn(cfg.batch_size, cfg.seq_length,
+                 cfg.hidden_size).astype(np.float32)
+    y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+    out_cfg = dataclasses.asdict(cfg)
+    out_cfg.update(slices=2, mesh=dict(zip(ff.mesh.axis_names,
+                                           ff.mesh.devices.shape)))
+    return ff, [x], y, out_cfg
+
+
 WORKLOADS = [
     ("bert_proxy", build_bert_proxy, 30),
     ("inception_proxy", build_inception_proxy, 10),
     ("dlrm", build_dlrm, 30),
     ("moe", build_moe, 30),
     ("pipeline_transformer", build_pipeline_transformer, 10),
+    ("multislice_transformer", build_multislice_transformer, 10),
 ]
 
 
@@ -445,6 +491,19 @@ def census_bytes_of(summary):
     total), or None."""
     total = (summary or {}).get("collectives_total") or {}
     b = total.get("bytes")
+    return float(b) if b is not None else None
+
+
+def dcn_bytes_of(summary):
+    """Per-device CROSS-SLICE collective bytes the compiled step moves
+    (the fabric-split census's DCN bucket — only present on a
+    ('slice', ...) mesh), or None. Informational this round: recorded
+    per workload alongside collective_bytes, not yet ratcheted —
+    BENCH_NOTES documents the attribution methodology; the ratchet
+    lands once a chip-validated multi-slice baseline exists."""
+    fab = (summary or {}).get("collectives_by_fabric") or {}
+    dcn = fab.get("dcn") or {}
+    b = dcn.get("bytes")
     return float(b) if b is not None else None
 
 
@@ -737,6 +796,12 @@ def main():
                 census_regressions.append(
                     f"{name}: {cbytes:.0f} B/step vs recorded best "
                     f"{byte_base:.0f}")
+        dcn = dcn_bytes_of(summary)
+        if dcn is not None:
+            # fabric attribution (multi-slice meshes only): cross-slice
+            # byte volume per step — informational this round, the DCN
+            # ratchet follows once a chip-validated baseline exists
+            wl["dcn_bytes"] = round(dcn, 1)
         if hbm_peak is not None:
             # memory sibling of the census gate: per-device HBM peak from
             # XLA's compiled memory analysis (the metric weight-update

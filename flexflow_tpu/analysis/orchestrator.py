@@ -40,6 +40,7 @@ class LintContext:
                  config=None, final_ref: Optional[Tuple[int, int]] = None,
                  ff=None, hlo_text: Optional[str] = None,
                  hlo_per_host: Optional[List[str]] = None,
+                 slice_of_host: Optional[List[int]] = None,
                  priced: Optional[Dict[str, float]] = None,
                  emitted: Optional[Dict[str, float]] = None,
                  searched: Optional[bool] = None):
@@ -52,6 +53,11 @@ class LintContext:
         self.ff = ff
         self.hlo_text = hlo_text
         self.hlo_per_host = hlo_per_host
+        # multi-slice process topology: slice_of_host[i] is the slice id
+        # of hlo_per_host[i]'s process — the multihost-order pass then
+        # checks within-slice order per slice AND the cross-slice leader
+        # agreement (FFL503) instead of one flat comparison
+        self.slice_of_host = slice_of_host
         self.priced = priced      # simulator-priced {kind: bytes}, lazy
         self.emitted = emitted    # HLO-census {kind: bytes}, lazy
         # whether the strategy came from the auto-parallelization search
@@ -155,13 +161,18 @@ def run_passes(ctx: LintContext, passes=None) -> LintReport:
 
 
 def lint_model(ff, hlo=None, passes=None,
-               hlo_per_host: Optional[List[str]] = None) -> LintReport:
+               hlo_per_host: Optional[List[str]] = None,
+               slice_of_host: Optional[List[int]] = None) -> LintReport:
     """Lint a compiled FFModel.
 
     ``hlo``: None runs the static passes only; ``True`` lowers+compiles
     the train step to include the emitted-HLO checks (expensive — one
     full XLA compile); a string is used as the optimized-HLO text
     directly (e.g. from a saved dump or a prior ``train_step_hlo``).
+    ``slice_of_host``: per-entry slice ids for ``hlo_per_host`` on a
+    multi-slice deployment — the multihost-order pass then reports
+    within-slice divergence with slice attribution plus FFL503 when
+    the slice leaders disagree across the DCN.
     """
     if ff.executor is None:
         raise ValueError("lint_model needs a compiled model — call "
@@ -176,5 +187,5 @@ def lint_model(ff, hlo=None, passes=None,
         nodes=ff.executor.nodes, mesh=ff.mesh, strategy=ff.strategy,
         machine_spec=ff.machine_spec, config=ff.config,
         final_ref=ff.executor.final_ref, ff=ff, hlo_text=hlo_text,
-        hlo_per_host=hlo_per_host)
+        hlo_per_host=hlo_per_host, slice_of_host=slice_of_host)
     return run_passes(ctx, passes=passes)

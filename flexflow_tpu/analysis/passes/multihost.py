@@ -17,6 +17,16 @@ and compares the ordered collective sequences:
           a guaranteed deadlock/corruption at step time;
 * FFL502  a host's program has a different collective COUNT (one host
           will wait forever on a collective its peers never enter).
+
+On a multi-slice deployment (``LintContext.slice_of_host`` maps each
+program to its slice) the comparison is hierarchical, matching the
+fabric the collectives rendezvous over: FFL501/502 are checked WITHIN
+each slice (against the slice's first host, diagnostics name the
+slice), and the slice leaders are then compared across the DCN:
+
+* FFL503  two slices' leader programs diverge (order, kind, shape, or
+          count) — the cross-slice collective (the DCN gradient sync)
+          deadlocks even though every slice is internally consistent.
 """
 
 from __future__ import annotations
@@ -60,26 +70,67 @@ class MultihostOrderPass:
                            "order-consistent by construction")
         diags: List[Diagnostic] = []
         seqs = [collective_sequence(t) for t in texts]
+        slices = getattr(ctx, "slice_of_host", None)
+        if slices and len(slices) == len(seqs):
+            # hierarchical (multi-slice) comparison: within-slice order
+            # per slice, then the slice leaders across the DCN
+            groups = {}
+            for host, sl in enumerate(slices):
+                groups.setdefault(sl, []).append(host)
+            for sl, hosts in sorted(groups.items()):
+                lead = hosts[0]
+                for host in hosts[1:]:
+                    diags.extend(self._compare(
+                        seqs[lead], seqs[host],
+                        f"host {lead} (slice {sl})",
+                        f"host {host} (slice {sl})",
+                        "FFL502", "FFL501"))
+            leaders = [hosts[0] for _, hosts in sorted(groups.items())]
+            for sl, host in zip(sorted(groups)[1:], leaders[1:]):
+                diags.extend(self._compare(
+                    seqs[leaders[0]], seqs[host],
+                    f"slice {sorted(groups)[0]} leader (host "
+                    f"{leaders[0]})",
+                    f"slice {sl} leader (host {host})",
+                    "FFL503", "FFL503"))
+            return diags
         ref = seqs[0]
         for host, seq in enumerate(seqs[1:], start=1):
-            if len(seq) != len(ref):
+            diags.extend(self._compare(ref, seq, "host 0", f"host {host}",
+                                       "FFL502", "FFL501"))
+        return diags
+
+    @staticmethod
+    def _compare(ref, seq, ref_name: str, name: str, count_rule: str,
+                 order_rule: str) -> List[Diagnostic]:
+        """FFL50x diff of two collective sequences: one count
+        diagnostic and/or the first order divergence."""
+        diags: List[Diagnostic] = []
+        cross = count_rule == "FFL503"
+        if len(seq) != len(ref):
+            diags.append(error(
+                count_rule,
+                f"{name} issues {len(seq)} collectives, {ref_name} "
+                f"issues {len(ref)} — a host will block forever on "
+                f"a rendezvous its peers never enter",
+                hint=("cross-slice programs must agree for the DCN "
+                      "collectives to rendezvous — diff the slice "
+                      "leaders' programs" if cross else
+                      "diff the per-host programs; something "
+                      "host-dependent leaked into compilation")))
+        for k, (a, b) in enumerate(zip(ref, seq)):
+            if a != b:
                 diags.append(error(
-                    "FFL502",
-                    f"host {host} issues {len(seq)} collectives, host 0 "
-                    f"issues {len(ref)} — a host will block forever on "
-                    f"a rendezvous its peers never enter",
-                    hint="diff the per-host programs; something "
-                         "host-dependent leaked into compilation"))
-            for k, (a, b) in enumerate(zip(ref, seq)):
-                if a != b:
-                    diags.append(error(
-                        "FFL501",
-                        f"collective order diverges at position {k}: "
-                        f"host 0 runs {a[0]} {a[1]}, host {host} runs "
-                        f"{b[0]} {b[1]}",
-                        hint="mismatched collective sequences deadlock "
-                             "(or silently corrupt when kinds pair up "
-                             "wrong) — per-host programs must be "
-                             "identical"))
-                    break  # first divergence per host pair is enough
+                    order_rule,
+                    f"collective order diverges at position {k}: "
+                    f"{ref_name} runs {a[0]} {a[1]}, {name} runs "
+                    f"{b[0]} {b[1]}",
+                    hint=("the cross-slice gradient sync deadlocks "
+                          "even with every slice internally "
+                          "consistent" if cross else
+                          "mismatched collective sequences deadlock "
+                          "(or silently corrupt when kinds pair up "
+                          "wrong) — per-host programs must be "
+                          "identical")))
+                break  # first divergence per pair is enough
         return diags

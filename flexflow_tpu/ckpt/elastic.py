@@ -55,13 +55,36 @@ def plan_resume(manifest: Dict[str, Any],
     * ``"research"`` — topology changed: compile with a search budget
       so the native search picks a strategy for what survived, then
       load re-shards from the checkpointed shard index.
+
+    When the saving mesh carried a ``slice`` axis (multi-slice
+    training) and the lost devices are a whole number of slices, the
+    plan additionally classifies the topology change as
+    ``topology="slice_loss"`` with ``lost_slices`` /
+    ``surviving_slices`` counts: the surviving fleet is an intact
+    (smaller) multi-slice deployment — or a single slice, which
+    resumes WITHOUT ``--slices`` — so the re-search runs on the
+    surviving slice topology rather than an arbitrary device count.
+    Any other mismatch classifies as ``topology="device_change"``.
     """
     saved_mesh = {k: int(v) for k, v in (manifest.get("mesh") or {}).items()}
     saved_devices = int(manifest.get("num_devices") or
                         _prod(saved_mesh.values()))
     action = "reuse" if saved_devices == int(num_devices) else "research"
-    return dict(action=action, saved_mesh=saved_mesh,
+    plan = dict(action=action, saved_mesh=saved_mesh,
                 saved_devices=saved_devices, num_devices=int(num_devices))
+    saved_slices = int(saved_mesh.get("slice", 1))
+    if action == "research" and saved_slices > 1:
+        per_slice = saved_devices // saved_slices
+        n = int(num_devices)
+        if 0 < n < saved_devices and per_slice > 0 and n % per_slice == 0:
+            plan["topology"] = "slice_loss"
+            plan["surviving_slices"] = n // per_slice
+            plan["lost_slices"] = saved_slices - n // per_slice
+            plan["slices"] = n // per_slice  # the resume's --slices value
+            return plan
+    if action == "research":
+        plan["topology"] = "device_change"
+    return plan
 
 
 def write_saved_strategy(manifest: Dict[str, Any], path: str) -> str:

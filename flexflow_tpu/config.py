@@ -33,6 +33,15 @@ class FFConfig:
     # here: jax.distributed — auto-detected on TPU pods, explicit on CPU)
     coordinator_address: Optional[str] = None
     node_rank: int = -1  # -1 = auto-detect
+    # multi-slice DCN hierarchy (flexflow_tpu/multislice): > 1 splits the
+    # visible chips into that many DCN-connected slices. The machine
+    # model prices cross-slice collectives at DCN rates, the search
+    # composes an outer DP/WUS axis over DCN with the within-slice
+    # hybrid, and the runtime mesh grows an OUTER 'slice' axis whose
+    # gradient sync reuses the WUS bucketed-RS chaining (the slow DCN
+    # sync hides under backward compute). 1 = the flat single-slice
+    # model (bit-identical to pre-multislice behavior).
+    slices: int = 1
     memory_per_chip_mb: int = 16 * 1024  # analog of -ll:fsize
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
@@ -227,6 +236,13 @@ class FFConfig:
                 self.coordinator_address = take()
             elif a == "--node-rank":
                 self.node_rank = int(take())
+            elif a == "--slices":
+                v = int(take())
+                if v < 1:
+                    raise ValueError(
+                        f"--slices expects >= 1 (1 = single flat slice), "
+                        f"got {v}")
+                self.slices = v
             elif a == "--budget" or a == "--search-budget":
                 self.search_budget = int(take())
             elif a == "--alpha" or a == "--search-alpha":

@@ -308,6 +308,74 @@ class MachineSpec:
             self.ici_bw * 2
         )
 
+    def slices_spanned(self, num_chips: int) -> int:
+        """How many slices a ``num_chips`` collective group crosses.
+        1 = fits inside one ICI domain (pure ICI pricing)."""
+        if self.num_slices <= 1 or self.chips_per_slice <= 0:
+            return 1
+        if num_chips <= self.chips_per_slice:
+            return 1
+        return min(self.num_slices,
+                   -(-num_chips // self.chips_per_slice))
+
+    def dcn_collective_time(self, kind: str, bytes_: float,
+                            slices: int) -> float:
+        """Ring-collective cost over the cross-slice DCN fabric:
+        ``slices`` participants (one leader chip per slice), paced by
+        ``effective_dcn()``'s bottleneck (bandwidth, latency)."""
+        k = int(slices)
+        if k <= 1:
+            return 0.0
+        bw, lat = self.effective_dcn()
+        if kind == "all-reduce":
+            return lat * (k - 1) + (2 * (k - 1) / k) * bytes_ / bw
+        if kind in ("reduce-scatter", "all-gather"):
+            return lat * (k - 1) + ((k - 1) / k) * bytes_ / bw
+        if kind == "all-to-all":
+            return lat + bytes_ * (k - 1) / k / bw
+        if kind == "collective-permute":
+            return lat + bytes_ / bw
+        return lat * (k - 1) + (2 * (k - 1) / k) * bytes_ / bw
+
+    def hier_collective_time(self, kind: str, bytes_: float,
+                             num_chips: int) -> float:
+        """Two-level decomposition of a collective whose group spans
+        slices — the multislice pricing rule (native twin:
+        ``hier_allreduce_time`` in ffs_machine.hpp).
+
+        Allreduce: intra-slice reduce-scatter at ICI + cross-slice
+        allreduce of the 1/chips_per_slice shard at DCN + intra-slice
+        all-gather at ICI. The other kinds decompose analogously: the
+        intra-slice leg runs at ICI over ``chips_per_slice`` chips and
+        the cross-slice leg moves the per-slice shard over the DCN
+        ring. Bytes follow ``collective_time``'s census conventions
+        (per-partition payloads; reduce-scatter counts per-shard OUTPUT
+        bytes)."""
+        inner = min(self.chips_per_slice, num_chips)
+        k = self.slices_spanned(num_chips)
+        if k <= 1:
+            return self.collective_time(kind, bytes_, num_chips)
+        if kind == "all-reduce":
+            return (self.ici_allreduce_time(bytes_, inner) / 2
+                    + self.dcn_collective_time(kind, bytes_ / inner, k)
+                    + self.ici_allgather_time(bytes_, inner))
+        if kind == "reduce-scatter":
+            full = bytes_ * num_chips  # census counted per-shard output
+            return (self.ici_allreduce_time(full, inner) / 2
+                    + self.dcn_collective_time(kind, full / inner, k))
+        if kind == "all-gather":
+            return (self.dcn_collective_time(kind, bytes_ / inner, k)
+                    + self.ici_allgather_time(bytes_, inner))
+        if kind == "all-to-all":
+            return (self.dcn_collective_time(kind, bytes_, k)
+                    + self.ici_alltoall_time(bytes_, inner))
+        if kind == "collective-permute":
+            # the ring wrap hop crosses slices — DCN-paced
+            return self.dcn_collective_time(kind, bytes_, k)
+        return (self.ici_allreduce_time(bytes_, inner) / 2
+                + self.dcn_collective_time("all-reduce", bytes_ / inner, k)
+                + self.ici_allgather_time(bytes_, inner))
+
     def collective_time(self, kind: str, bytes_: float,
                         num_chips: int) -> float:
         """Analytic time for ``bytes_`` moved by one HLO collective kind
@@ -318,6 +386,11 @@ class MachineSpec:
         per-partition (SPMD module), which matches these formulas'
         per-chip payload convention.
 
+        When the group spans slices (``num_chips > chips_per_slice`` on
+        a multi-slice spec) the hierarchical ICI+DCN decomposition
+        prices it instead — any collective that crosses the slice
+        boundary pays DCN rates for the cross-slice leg.
+
         When ``collective_corrections`` carries a measured factor for
         ``kind`` (device-trace attribution calibration,
         ``scripts/calibrate.py --ingest-drift``), the analytic time is
@@ -325,6 +398,11 @@ class MachineSpec:
         item (a))."""
         if num_chips <= 1:
             return 0.0
+        if self.slices_spanned(num_chips) > 1:
+            t = self.hier_collective_time(kind, bytes_, num_chips)
+            if self.collective_corrections:
+                t *= self.collective_corrections.get(kind, 1.0)
+            return t
         if kind == "all-reduce":
             t = self.ici_allreduce_time(bytes_, num_chips)
         elif kind == "reduce-scatter":
@@ -392,18 +470,27 @@ def load_collective_corrections(platform: str,
     return out
 
 
-def detect_machine_spec(num_devices: Optional[int] = None) -> MachineSpec:
+def detect_machine_spec(num_devices: Optional[int] = None,
+                        slices: int = 1) -> MachineSpec:
     """Build a MachineSpec from the live JAX backend (used at compile
-    time). On a real chip, measured per-collective calibration from
-    CALIBRATION.json engages automatically (platform-gated like
-    search/profile's op corrections; FFS_NO_DRIFT_CORRECTIONS opts
-    out) — CPU runs never pick up chip factors or vice versa."""
+    time). ``slices > 1`` splits the detected chips into that many
+    DCN-connected slices (``FFConfig --slices``): chips_per_slice =
+    n // slices, with the per-generation default ICI torus factored
+    per SLICE rather than over the flat device count. On a real chip,
+    measured per-collective calibration from CALIBRATION.json engages
+    automatically (platform-gated like search/profile's op corrections;
+    FFS_NO_DRIFT_CORRECTIONS opts out) — CPU runs never pick up chip
+    factors or vice versa."""
     import os
 
     import jax
 
     devs = jax.devices()
     n = num_devices or len(devs)
+    s = max(1, int(slices))
+    if s > 1 and n % s != 0:
+        raise ValueError(
+            f"--slices {s} does not divide the {n} visible devices")
     kind = devs[0].device_kind.lower() if devs else "cpu"
     if "v5 lite" in kind or "v5e" in kind:
         chip = "tpu-v5e"
@@ -415,7 +502,7 @@ def detect_machine_spec(num_devices: Optional[int] = None) -> MachineSpec:
         chip = "tpu-v6e"
     else:
         chip = "cpu-sim"
-    spec = MachineSpec(chip=chip, chips_per_slice=n)
+    spec = MachineSpec(chip=chip, chips_per_slice=n // s, num_slices=s)
     platform = devs[0].platform if devs else "cpu"
     if platform != "cpu" and not os.environ.get("FFS_NO_DRIFT_CORRECTIONS"):
         corr = load_collective_corrections(platform)

@@ -551,7 +551,8 @@ class FFModel:
         elif cfg.machine_model_version > 0 and not cfg.machine_model_file:
             raise ValueError(
                 "--machine-model-version > 0 requires --machine-model-file")
-        self.machine_spec = machine_spec or detect_machine_spec(n_dev)
+        self.machine_spec = machine_spec or detect_machine_spec(
+            n_dev, slices=getattr(cfg, "slices", 1))
         self.search_info = None
         # search-objective provenance: "step_time" (TRAINING search),
         # "latency" (INFERENCE search), None (no search ran) — recorded
@@ -666,6 +667,30 @@ class FFModel:
             _unity.export_strategy_file(cfg.export_strategy_file, axes_now,
                                         self.strategy, nodes,
                                         objective=self.search_objective)
+        # multi-slice runtime axis (flexflow_tpu/multislice): --slices N
+        # splits the searched 'data' extent into an OUTER 'slice' axis
+        # times the within-slice remainder, and extends every
+        # 'data'-sharded PartitionSpec across both. The split happens
+        # AFTER strategy export (strategy files stay flat/portable) and
+        # before apply_strategy. The cross-slice axis carries data
+        # parallelism only — matching the native search's
+        # inner_axes_cross_slice mesh gate — so its gradient sync rides
+        # the WUS bucketed-RS chaining like any other data axis.
+        n_slices = max(1, int(getattr(cfg, "slices", 1) or 1))
+        if n_slices > 1 and "slice" not in self.mesh.axis_names:
+            axes_flat = dict(zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape))
+            if axes_flat.get("pipe", 1) > 1:
+                raise ValueError(
+                    "--slices > 1 does not compose with a 'pipe' mesh: "
+                    "the cross-slice axis must carry data parallelism only "
+                    "(pass --disable-pipeline-parallel, or --slices 1)")
+            from flexflow_tpu.multislice import (remap_strategy_for_slices,
+                                                 slice_axes)
+            sliced_axes = slice_axes(axes_flat, n_slices)
+            self.mesh = make_mesh(_math.prod(sliced_axes.values()),
+                                  sliced_axes)
+            remap_strategy_for_slices(self.strategy)
         apply_strategy(nodes, self.strategy, self.mesh)
         self.op_profile = None
         if cfg.profiling:
@@ -698,7 +723,12 @@ class FFModel:
                              self.machine_spec.chip != "cpu-sim")
             else jnp.float32
         )
-        data_axes = tuple(a for a in self.mesh.axis_names if a in ("data", "replica"))
+        # 'slice' is a data axis to the executor: batch sharding, the
+        # WUS/optimizer-state sharding, and the bucketed-RS gradient sync
+        # all extend across it (the cross-slice sync is the slow DCN leg
+        # the '_ovl' pricing hides under backward compute)
+        data_axes = tuple(a for a in self.mesh.axis_names
+                          if a in ("slice", "data", "replica"))
         axes_now = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         # weight-update sharding (WUS): reduce-scatter gradient sync +
         # data-sharded master params / optimizer moments + fused all-gather

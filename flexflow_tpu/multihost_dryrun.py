@@ -941,6 +941,282 @@ def run_supervised_dryrun(watchdog_timeout: float = 10.0) -> dict:
     return summary
 
 
+# ---------------------------------------------------------------------------
+# multi-slice legs (ISSUE 16): N process sets stand in for N
+# DCN-connected slices — the ('slice', 'data') runtime mesh crosses the
+# set boundary exactly where a real deployment crosses the DCN. The
+# lint pass checks per-slice collective order (FFL501/502 with slice
+# attribution) plus the cross-slice leader agreement (FFL503), and the
+# kill-one-slice leg exercises plan_resume's slice_loss topology class.
+
+
+def _build_multislice(total_devices: int, num_slices: int):
+    """Compile the dryrun model over a ('slice', 'data') mesh:
+    ``--slices`` splits the flat data mesh in model.compile, so the
+    gradient sync's cross-slice leg rides the outer axis."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.machine import make_mesh
+    from flexflow_tpu.models.transformer import create_transformer
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    cfg = _model_config(total_devices)
+    c = FFConfig(batch_size=cfg.batch_size)
+    c.slices = num_slices
+    ff = create_transformer(cfg, c)
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               mesh=make_mesh(total_devices, {"data": total_devices}))
+    assert "slice" in ff.mesh.axis_names, ff.mesh.axis_names
+    return ff
+
+
+def multislice_worker_main(process_id: int, num_processes: int, port: int,
+                           devices_per_proc: int, out_path: str,
+                           ckpt_dir: str, num_slices: int, steps: int,
+                           every: int) -> None:
+    """One participant of a multi-slice leg: processes form
+    ``num_slices`` contiguous sets (slice-major, matching the
+    ('slice', ...) mesh's device order), train over the cross-slice
+    data axis with per-shard checkpointing, honor FFS_FAULT, and dump
+    the per-host optimized HLO for the hierarchical lint pass."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import distributed
+    from flexflow_tpu.multislice import slice_of_process
+
+    distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+    total = jax.device_count()
+    my_slice = slice_of_process(process_id, num_processes, num_slices)
+    ff = _build_multislice(total, num_slices)
+    cfg = _model_config(total)
+    x, y = _global_batch(cfg)
+    rows, lo = distributed.local_batch_rows(
+        ff.executor.batch_sharding(), x.shape[0])
+    lx, ly = x[lo:lo + rows], y[lo:lo + rows]
+    trace_dir = os.environ.get("FFS_TRACE_DIR") or None
+    if trace_dir:
+        from flexflow_tpu.search.validate import train_step_hlo
+        hlo_path = os.path.join(trace_dir,
+                                f"train_step_host{process_id}.hlo.txt")
+        with open(hlo_path, "w") as f:
+            f.write(train_step_hlo(ff))
+    mgr = None
+    if ckpt_dir:
+        from flexflow_tpu.ckpt import CheckpointManager
+        mgr = CheckpointManager(ff, ckpt_dir, every=every, retain=3,
+                                async_write=True, run_name="msdryrun",
+                                fs_timeout=60.0)
+    losses = _elastic_train_loop(ff, lx, ly, 0, steps, mgr)
+    if mgr is not None:
+        mgr.finalize(elapsed_s=None, steps=None)
+    np.savez(out_path, losses=np.asarray(losses, np.float64),
+             slice_id=np.int64(my_slice),
+             mesh_axes=np.asarray(
+                 [f"{a}={s}" for a, s in zip(ff.mesh.axis_names,
+                                             ff.mesh.devices.shape)]))
+
+
+def _lint_per_slice_hlo(trace_dir: str, num_processes: int,
+                        num_slices: int, ff) -> None:
+    """Feed the workers' per-host HLO dumps through fflint's
+    hierarchical multihost-order pass: within-slice FFL501/502 with
+    slice attribution plus the FFL503 cross-slice leader comparison.
+    Raises on any order diagnostic."""
+    from flexflow_tpu.multislice import slice_of_process
+    texts = []
+    for p in range(num_processes):
+        path = os.path.join(trace_dir, f"train_step_host{p}.hlo.txt")
+        if not os.path.exists(path):
+            raise AssertionError(
+                f"multislice dryrun: worker {p} did not dump its "
+                f"train-step HLO ({path})")
+        with open(path) as f:
+            texts.append(f.read())
+    slice_of = [slice_of_process(p, num_processes, num_slices)
+                for p in range(num_processes)]
+    from flexflow_tpu.analysis import lint_model
+    rep = lint_model(ff, hlo_per_host=texts, slice_of_host=slice_of)
+    order = [d for d in rep.diagnostics
+             if d.rule in ("FFL501", "FFL502", "FFL503")]
+    if order:
+        raise AssertionError(
+            "multislice dryrun: per-slice collective sequences diverge:\n"
+            + "\n".join(d.format() for d in order))
+    if rep.passes.get("multihost-order") != "ok":
+        raise AssertionError(
+            f"multislice dryrun: multihost-order pass did not run: "
+            f"{rep.passes.get('multihost-order')}")
+    print(f"multislice dryrun: fflint multihost-order ok over "
+          f"{num_slices} slices x {num_processes // num_slices} "
+          f"processes (FFL501/502/503 clean)")
+
+
+def run_multislice_dryrun(num_slices: int = 2, procs_per_slice: int = 2,
+                          devices_per_proc: int = 1, steps: int = 6,
+                          every: int = 2, kill_step: int = 4,
+                          timeout: int = 300) -> dict:
+    """Multi-slice training end to end, devicelessly.
+
+    Phase A: ``num_slices x procs_per_slice`` processes train over a
+    ('slice', 'data') mesh whose slice axis crosses the process-set
+    boundary; every process dumps its optimized HLO and the
+    hierarchical fflint pass must come back FFL501/502/503-clean.
+    Phase B: the same run with per-shard checkpointing and
+    ``FFS_FAULT`` killing a rank in the LAST slice mid-epoch — losing
+    a host loses its slice; the directory must hold a complete
+    manifest-committed checkpoint whose mesh records the slice axis.
+    Phase C (in-process): ``plan_resume`` on the surviving slice's
+    device count must classify the change as ``slice_loss`` (1 of
+    ``num_slices`` slices lost, resume ``--slices`` = survivors), the
+    survivors compile WITHOUT a slice axis (single surviving slice) —
+    re-searched when the native search is available — and the
+    continued losses match the reference within reduction-order
+    tolerance. Returns a summary dict."""
+    import jax
+
+    num_processes = num_slices * procs_per_slice
+    total = num_processes * devices_per_proc
+    kill_rank = num_processes - 1  # a host of the last slice
+    summary = {}
+    with tempfile.TemporaryDirectory() as td:
+        ckpt_dir = os.path.join(td, "ckpts")
+        trace_dir = os.path.join(td, "trace")
+        os.makedirs(trace_dir)
+
+        # ---- phase A: reference run + hierarchical lint -----------------
+        outs = [os.path.join(td, f"ref{p}.npz") for p in range(num_processes)]
+        rcs = _spawn("multislice_worker_main", num_processes,
+                     devices_per_proc, outs,
+                     ["", num_slices, steps, every],
+                     _worker_env(trace_dir=trace_dir), timeout,
+                     tolerate_failures=False)
+        if any(rc != 0 for rc in rcs):
+            raise RuntimeError(
+                f"multislice dryrun reference: exit codes {rcs}")
+        ref = np.load(outs[0])["losses"]
+        if len(ref) != steps or not np.all(np.isfinite(ref)):
+            raise AssertionError(f"reference losses malformed: {ref}")
+        for p, out in enumerate(outs):
+            got = np.load(out)
+            want_slice = p // procs_per_slice
+            if int(got["slice_id"]) != want_slice:
+                raise AssertionError(
+                    f"worker {p} mapped to slice {int(got['slice_id'])}, "
+                    f"expected {want_slice}")
+            if not np.array_equal(got["losses"], ref):
+                raise AssertionError(
+                    f"worker {p} loss series diverges from rank 0 — the "
+                    f"cross-slice sync is broken")
+        if len(jax.devices()) < total:
+            raise RuntimeError(
+                f"multislice dryrun needs {total} local devices for the "
+                f"lint-context leg, have {len(jax.devices())}")
+        ff_lint = _build_multislice(total, num_slices)
+        _lint_per_slice_hlo(trace_dir, num_processes, num_slices, ff_lint)
+        summary["lint"] = "ok"
+
+        # ---- phase B: kill one slice mid-epoch --------------------------
+        from flexflow_tpu.ckpt.faults import KILL_EXIT
+        env = _worker_env(trace_dir=None)
+        env["FFS_FAULT"] = f"kill_host:{kill_rank}@step:{kill_step}"
+        outs_b = [os.path.join(td, f"fault{p}.npz")
+                  for p in range(num_processes)]
+        rcs = _spawn("multislice_worker_main", num_processes,
+                     devices_per_proc, outs_b,
+                     [ckpt_dir, num_slices, steps, every], env, timeout,
+                     tolerate_failures=True)
+        if rcs[kill_rank] != KILL_EXIT:
+            raise AssertionError(
+                f"fault leg: rank {kill_rank} was meant to die with exit "
+                f"{KILL_EXIT} at step {kill_step}, got exit codes {rcs}")
+        from flexflow_tpu.ckpt import latest_complete, verify_step_dir
+        latest = latest_complete(ckpt_dir)
+        if latest is None:
+            raise AssertionError(
+                "fault leg left no complete checkpoint")
+        resume_step, step_dir = latest
+        rep = verify_step_dir(step_dir)
+        if not rep["complete"]:
+            raise AssertionError(
+                f"latest checkpoint fails deep verification: "
+                f"{rep['errors']}")
+        summary["resume_step"] = resume_step
+
+        # ---- phase C: slice-loss resume on the survivors ----------------
+        from flexflow_tpu.ckpt import load_manifest, plan_resume
+        manifest = load_manifest(step_dir)
+        if int(manifest.get("mesh", {}).get("slice", 0)) != num_slices:
+            raise AssertionError(
+                f"checkpoint manifest does not record the slice axis: "
+                f"{manifest.get('mesh')}")
+        n_survive = total - total // num_slices
+        plan = plan_resume(manifest, n_survive)
+        if plan.get("topology") != "slice_loss":
+            raise AssertionError(
+                f"plan_resume did not classify losing a slice "
+                f"({n_survive}/{total} devices): {plan}")
+        if (plan["lost_slices"] != 1
+                or plan["surviving_slices"] != num_slices - 1
+                or plan["slices"] != num_slices - 1):
+            raise AssertionError(f"slice_loss plan malformed: {plan}")
+        if len(jax.devices()) < n_survive:
+            raise RuntimeError(
+                f"multislice dryrun needs {n_survive} local devices for "
+                f"the resume leg, have {len(jax.devices())}")
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.machine import make_mesh
+        from flexflow_tpu.models.transformer import create_transformer
+        from flexflow_tpu.optimizers import SGDOptimizer
+        from flexflow_tpu.search.native import available as _native_ok
+        cfg = _model_config(total)
+        budget = 6 if _native_ok() else 0
+        c_small = FFConfig(batch_size=cfg.batch_size,
+                           workers_per_node=n_survive,
+                           search_budget=budget)
+        c_small.slices = plan["slices"] if plan["slices"] > 1 else 1
+        ff_small = create_transformer(cfg, c_small)
+        ff_small.compile(SGDOptimizer(lr=0.05),
+                         LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                         mesh=None if budget else make_mesh(
+                             n_survive, {"data": n_survive}))
+        it = ff_small.load_checkpoint(step_dir)
+        if it != resume_step:
+            raise AssertionError(
+                f"slice-loss load restored iteration {it}, expected "
+                f"{resume_step}")
+        x, y = _global_batch(cfg)
+        cont = _elastic_train_loop(ff_small, x, y, resume_step, steps)
+        if not np.all(np.isfinite(cont)):
+            raise AssertionError(
+                f"slice-loss resume produced non-finite losses: {cont}")
+        if not np.allclose(cont, ref[resume_step:], rtol=1e-3, atol=1e-5):
+            raise AssertionError(
+                f"slice-loss resumed losses diverged beyond reduction-"
+                f"order tolerance\n  resumed {cont}\n  "
+                f"expected {ref[resume_step:]}")
+        summary["surviving_mesh"] = dict(zip(ff_small.mesh.axis_names,
+                                             ff_small.mesh.devices.shape))
+        summary["researched"] = bool(budget)
+    print(f"multislice dryrun ok: {num_slices} slices x "
+          f"{procs_per_slice} processes, lint FFL501/502/503 clean; "
+          f"killed slice {num_slices - 1} at step {kill_step}, "
+          f"plan_resume classified slice_loss, survivors "
+          f"{summary['surviving_mesh']} "
+          f"({'re-searched' if summary['researched'] else 'heuristic'} "
+          f"strategy) resumed from iteration {summary['resume_step']} "
+          f"within tolerance")
+    return summary
+
+
 def run_dryrun(num_processes: int = 2, devices_per_proc: int = 2,
                timeout: int = 600,
                trace_dir: Optional[str] = None,

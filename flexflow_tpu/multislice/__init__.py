@@ -1,0 +1,37 @@
+"""Multi-slice DCN hierarchy: the two-level machine model.
+
+One TPU slice is an ICI domain — a torus of chips whose links the
+per-axis ring pricing in ``native/ffs_machine.hpp`` models. Past one
+slice, traffic crosses the data-center network (DCN): ~25 GB/s per
+slice pair against 45-90 GB/s per ICI link, and 10 us latency against
+1 us. The reference fork's ``NetworkedMachineModel``
+(include/flexflow/simulator.h:515) made exactly this fabric split a
+first-class pricing input; this package is the TPU-native
+re-expression.
+
+* ``MultiSliceSpec`` — the user-facing description (N slices x
+  per-slice ICI torus, DCN bandwidth/latency/links), convertible to
+  and from the ``machine.MachineSpec`` the search consumes;
+* mesh helpers — split the searched data extent into an outer
+  ``('slice', 'data', ...)`` axis pair and remap strategy
+  PartitionSpecs so every ``'data'``-sharded dim extends across the
+  slice axis (the runtime side of the hierarchical DP/WUS strategy);
+* process-set helpers — map multihost process indices onto slices for
+  the deviceless dryrun and the per-slice FFL5xx lint groups.
+"""
+
+from flexflow_tpu.multislice.spec import (MultiSliceSpec,
+                                          multislice_machine_spec)
+from flexflow_tpu.multislice.mesh import (remap_strategy_for_slices,
+                                          slice_axes,
+                                          slice_process_groups,
+                                          slice_of_process)
+
+__all__ = [
+    "MultiSliceSpec",
+    "multislice_machine_spec",
+    "slice_axes",
+    "remap_strategy_for_slices",
+    "slice_process_groups",
+    "slice_of_process",
+]

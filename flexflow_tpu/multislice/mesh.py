@@ -1,0 +1,110 @@
+"""Mesh and process-set plumbing for the ('slice', ...) outer axis.
+
+The hierarchical strategy the search picks on a multi-slice machine is
+"DP/WUS over DCN x searched hybrid within each slice": the cross-slice
+axis only ever carries data parallelism (gradient sync), because any
+tensor-parallel axis that crossed slices would put per-layer
+collectives on the slow fabric — the ``inner_axes_cross_slice`` mesh
+gate in ``ffs_search.cpp`` rejects those meshes outright. The runtime
+mirror of that invariant lives here: split the searched 'data' extent
+into an OUTER 'slice' axis times the within-slice remainder, and
+extend every 'data'-sharded PartitionSpec entry across both. With
+'slice' in the executor's ``data_axes``, the WUS bucketed-RS chaining
+then prices/hides the slow DCN gradient sync exactly like any other
+data axis — which is where bucketed async RS pays most.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from jax.sharding import PartitionSpec as P
+
+
+def slice_axes(axes: Dict[str, int], slices: int) -> Dict[str, int]:
+    """Split a searched mesh's 'data' extent into ``{'slice': s,
+    'data': dp // s, ...}`` with 'slice' OUTERMOST — so the flat
+    device order lays consecutive chips within a slice (slice-major),
+    matching how real multislice fleets enumerate devices.
+
+    The slice count must divide the data extent: the cross-slice axis
+    carries only data parallelism (see module docstring), so a search
+    result whose dp the slice count does not divide cannot run on this
+    fleet — that is a configuration error, not something to paper over.
+    """
+    s = max(1, int(slices))
+    if s == 1:
+        return dict(axes)
+    dp = int(axes.get("data", 1))
+    if dp % s != 0:
+        raise ValueError(
+            f"--slices {s} does not divide the searched data extent {dp} "
+            f"(mesh {axes}); the cross-slice axis carries data parallelism "
+            f"only, so slices must divide dp")
+    out: Dict[str, int] = {"slice": s}
+    for name, ext in axes.items():
+        out[name] = dp // s if name == "data" else int(ext)
+    if "data" not in out:
+        out["data"] = 1
+    return out
+
+
+def _remap_entry(entry):
+    """'data' -> ('slice', 'data') inside one PartitionSpec entry,
+    flattening tuples (a dim sharded dp ways is now sharded s * dp/s
+    ways across both axes)."""
+    if entry is None:
+        return None
+    entries = entry if isinstance(entry, tuple) else (entry,)
+    out: List[str] = []
+    for a in entries:
+        if a == "data":
+            out.extend(("slice", "data"))
+        else:
+            out.append(a)
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def _remap_spec(spec):
+    if spec is None:
+        return None
+    entries = [_remap_entry(e) for e in spec]
+    return P(*entries)
+
+
+def remap_strategy_for_slices(strategy) -> None:
+    """In-place: every 'data' axis reference in a Strategy's
+    PartitionSpecs becomes ('slice', 'data'). Run after the search
+    (which saw the flat dp extent) and before ``apply_strategy`` on
+    the slice-split mesh."""
+    for st in strategy.values():
+        st.output_specs = [_remap_spec(s) for s in st.output_specs]
+        st.param_specs = {k: _remap_spec(v)
+                          for k, v in st.param_specs.items()}
+
+
+def slice_of_process(process_index: int, num_processes: int,
+                     num_slices: int) -> int:
+    """Slice index of a multihost process (contiguous blocks: processes
+    [0, P/S) are slice 0, etc. — slice-major, matching ``slice_axes``'s
+    device order)."""
+    if num_slices <= 1:
+        return 0
+    if num_processes % num_slices != 0:
+        raise ValueError(
+            f"{num_slices} slices do not evenly divide {num_processes} "
+            f"processes")
+    return int(process_index) // (num_processes // num_slices)
+
+
+def slice_process_groups(num_processes: int,
+                         num_slices: int) -> List[List[int]]:
+    """Process indices grouped by slice — the per-slice FFL5xx lint
+    groups and the dryrun's process sets."""
+    per = num_processes // max(1, num_slices)
+    if num_slices >= 1 and num_processes % max(1, num_slices) != 0:
+        raise ValueError(
+            f"{num_slices} slices do not evenly divide {num_processes} "
+            f"processes")
+    return [list(range(s * per, (s + 1) * per))
+            for s in range(max(1, num_slices))]

@@ -1,0 +1,119 @@
+"""MultiSliceSpec: N slices x per-slice ICI torus + the DCN between.
+
+``machine.MachineSpec`` already carries the two-level fields
+(``num_slices``, ``dcn_bw``, ``dcn_latency``, ``dcn_links``) because
+``machine_to_json`` feeds them to the native search; this module gives
+them a front door. A ``MultiSliceSpec`` is what a user (or
+``FFConfig --slices``) states about the fleet — slice count, slice
+shape, fabric — and ``to_machine_spec()`` produces the search-ready
+``MachineSpec`` with the per-slice torus factored per SLICE (a flat
+spec would factor the full chip count into one big torus that does
+not exist).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from flexflow_tpu.machine import MachineSpec
+
+
+@dataclasses.dataclass
+class MultiSliceSpec:
+    """A fleet of ``num_slices`` identical TPU slices.
+
+    ``torus`` is the PER-SLICE ICI topology (e.g. ``(4, 4, 2)`` for a
+    v4-32 slice); None lets ``MachineSpec`` factor the per-generation
+    default. ``dcn_links`` optionally names an explicit slice-pair
+    fabric ``[(i, j, bytes_per_s), ...]`` — absent, the DCN is uniform
+    all-to-all at ``dcn_bw``.
+    """
+
+    num_slices: int = 2
+    chips_per_slice: int = 4
+    chip: str = "tpu-v4"
+    torus: Optional[Tuple[int, ...]] = None
+    dcn_bw: float = 25e9
+    dcn_latency: float = 10e-6
+    dcn_links: Optional[Sequence[Tuple[int, int, float]]] = None
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {self.num_slices}")
+        if self.chips_per_slice < 1:
+            raise ValueError(
+                f"chips_per_slice must be >= 1, got {self.chips_per_slice}")
+        if self.dcn_bw <= 0:
+            raise ValueError(f"dcn_bw must be > 0, got {self.dcn_bw}")
+        if self.dcn_latency < 0:
+            raise ValueError(
+                f"dcn_latency must be >= 0, got {self.dcn_latency}")
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_slices * self.chips_per_slice
+
+    def to_machine_spec(self, **overrides) -> MachineSpec:
+        """The search-ready ``MachineSpec`` twin. Keyword overrides pass
+        through to the MachineSpec constructor (e.g. calibration
+        factors, mxu_efficiency)."""
+        kw = dict(
+            chip=self.chip,
+            chips_per_slice=self.chips_per_slice,
+            num_slices=self.num_slices,
+            torus=self.torus,
+            dcn_bw=self.dcn_bw,
+            dcn_latency=self.dcn_latency,
+            dcn_links=self.dcn_links,
+        )
+        kw.update(overrides)
+        return MachineSpec(**kw)
+
+    @classmethod
+    def from_machine_spec(cls, spec: MachineSpec) -> "MultiSliceSpec":
+        return cls(
+            num_slices=spec.num_slices,
+            chips_per_slice=spec.chips_per_slice,
+            chip=spec.chip,
+            torus=tuple(spec.torus) if spec.torus else None,
+            dcn_bw=spec.dcn_bw,
+            dcn_latency=spec.dcn_latency,
+            dcn_links=spec.dcn_links,
+        )
+
+    def slice_of_device(self, device_index: int) -> int:
+        """Slice index of a flat device index (slice-major order — the
+        order ``model.compile`` lays the ('slice', ...) mesh out in)."""
+        return int(device_index) // self.chips_per_slice
+
+    def surviving(self, lost_slices: Sequence[int]) -> "MultiSliceSpec":
+        """The spec after losing ``lost_slices`` — the topology class
+        ``plan_resume`` re-searches for. Losing all slices is a crash,
+        not a resume plan."""
+        lost = {int(s) for s in lost_slices}
+        left = self.num_slices - len(lost & set(range(self.num_slices)))
+        if left < 1:
+            raise ValueError("no surviving slices to resume on")
+        links = None
+        if self.dcn_links:
+            # renumber the surviving slices densely; drop lost endpoints
+            keep = [i for i in range(self.num_slices) if i not in lost]
+            renum = {old: new for new, old in enumerate(keep)}
+            links = [(renum[i], renum[j], bw) for i, j, bw in self.dcn_links
+                     if i in renum and j in renum]
+        return dataclasses.replace(self, num_slices=left,
+                                   dcn_links=links or None)
+
+
+def multislice_machine_spec(num_devices: int, slices: int,
+                            chip: str = "cpu-sim",
+                            **overrides) -> MachineSpec:
+    """Convenience: the MachineSpec for ``num_devices`` chips split into
+    ``slices`` DCN-connected slices (the ``--slices`` flag's path)."""
+    s = max(1, int(slices))
+    if num_devices % s != 0:
+        raise ValueError(
+            f"slices={s} does not divide num_devices={num_devices}")
+    return MultiSliceSpec(num_slices=s, chips_per_slice=num_devices // s,
+                          chip=chip).to_machine_spec(**overrides)

@@ -94,6 +94,73 @@ def census_totals(census: Dict[str, Dict[str, float]]) -> Dict[str, float]:
     )
 
 
+_RG_RE = re.compile(
+    # explicit groups {{0,1},{2,3}} or the iota form [G,S]<=[dims]T(perm)
+    r"replica_groups=(\{\{[\d, {}]*\}\}|\[[\d,]+\]<=\[[\d,]+\]"
+    r"(?:T\([\d,]+\))?)")
+_RG_IOTA_RE = re.compile(
+    r"^\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?$")
+
+
+def parse_replica_groups(attr: str):
+    """Device-id groups of one collective's ``replica_groups`` HLO
+    attribute. Handles the explicit form ``{{0,1},{2,3}}`` and the iota
+    form ``[G,S]<=[dims]`` / ``[G,S]<=[dims]T(perm)`` (reshape
+    iota(prod(dims)) to dims, transpose by perm, reshape to G x S).
+    None when the attribute doesn't parse."""
+    import numpy as np
+    if attr.startswith("{{"):
+        return [[int(x) for x in grp.split(",") if x.strip()]
+                for grp in re.findall(r"\{([\d, ]*)\}", attr[1:-1])]
+    m = _RG_IOTA_RE.match(attr)
+    if not m:
+        return None
+    g, s = int(m.group(1)), int(m.group(2))
+    dims = [int(x) for x in m.group(3).split(",")]
+    arr = np.arange(int(np.prod(dims))).reshape(dims)
+    if m.group(4):
+        arr = arr.transpose([int(x) for x in m.group(4).split(",")])
+    return arr.reshape(g, s).tolist()
+
+
+def collective_census_by_fabric(hlo_text: str, chips_per_slice: int,
+                                min_bytes: float = 0.0
+                                ) -> Dict[str, Dict[str, float]]:
+    """The census split by fabric tier: ``{"ici": {count, bytes},
+    "dcn": {count, bytes}}`` over the optimized SPMD module.
+
+    A collective rides DCN when any of its replica groups contains
+    devices from more than one slice (device id // chips_per_slice, the
+    slice-major order ``model.compile`` lays the ('slice', ...) mesh
+    out in). A collective with no / unparseable replica_groups engages
+    every participant — on a multi-slice mesh that spans, so it counts
+    as DCN (conservative: the methodology BENCH_NOTES documents)."""
+    out = {"ici": dict(count=0, bytes=0.0), "dcn": dict(count=0, bytes=0.0)}
+    cps = max(1, int(chips_per_slice))
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        if " = " not in line:
+            continue
+        rhs = line.split(" = ", 1)[1]
+        m = _COLLECTIVE_RE.search(rhs)
+        if not m or m.group(2) == "-done":
+            continue
+        b = shape_bytes(rhs[:m.start()])
+        if b < min_bytes:
+            continue
+        rg = _RG_RE.search(rhs)
+        groups = parse_replica_groups(rg.group(1)) if rg else None
+        if groups:
+            spans = any(len({d // cps for d in g}) > 1
+                        for g in groups if g)
+        else:
+            spans = True  # flat/implicit group: all participants
+        e = out["dcn" if spans else "ici"]
+        e["count"] += 1
+        e["bytes"] += b
+    return out
+
+
 _FUSION_RE = re.compile(r"=\s+\S+\s+fusion(\.\d+)?\(")
 _CUSTOM_CALL_RE = re.compile(r"=\s+\S+\s+custom-call(\.\d+)?\(")
 
@@ -215,4 +282,16 @@ def inspect_model_step(ff) -> Dict[str, Any]:
     compiled = compiled_train_step(ff)
     out = inspect_compiled(compiled)
     out.update(model_context(ff))
+    # multi-slice fabric attribution: on a ('slice', ...) mesh, split the
+    # census by fabric tier — the cross-slice (DCN) byte volume is the
+    # coordinate bench.py records as dcn_bytes
+    try:
+        axis_names = tuple(getattr(ff.mesh, "axis_names", ()) or ())
+        if "slice" in axis_names:
+            axes = dict(zip(axis_names, ff.mesh.devices.shape))
+            cps = int(ff.mesh.devices.size) // int(axes["slice"])
+            out["collectives_by_fabric"] = collective_census_by_fabric(
+                compiled.as_text(), cps)
+    except Exception:
+        pass
     return out

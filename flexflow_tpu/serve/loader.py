@@ -74,7 +74,13 @@ def load_for_serving(manifest_dir: str, ff, *,
     # and a deploy must not leave a surprise budget-8 search behind
     saved_knobs = {k: getattr(cfg, k)
                    for k in ("search_budget", "enable_parameter_parallel",
-                             "only_data_parallel", "import_strategy_file")}
+                             "only_data_parallel", "import_strategy_file",
+                             "slices")}
+    if mesh is None and plan.get("topology") == "slice_loss":
+        # the checkpoint came from a multi-slice run and a whole number
+        # of slices is gone: serve on the surviving slice topology (a
+        # single survivor drops the slice axis entirely)
+        cfg.slices = int(plan["slices"])
     strategy_tmp = None
     mode = "heuristic"
     if mesh is not None:

@@ -753,36 +753,51 @@ bool mesh_eq(const MeshShape& a, const MeshShape& b) {
 }
 
 // The collectives a choice statically implies (kind, global bytes, ring
-// size, cause) — the "what would this cost on the wire" column of the
-// explain table, mirroring the census records the simulators emit.
-Json choice_collectives_json(const Choice& c, bool training) {
+// size, cause, fabric) — the "what would this cost on the wire" column of
+// the explain table, mirroring the census records the simulators emit.
+// `fabric` names the slowest fabric tier the ring crosses: "ici" inside
+// one slice, "dcn" when the ring spans slices. Mesh legality keeps the
+// inner (model/seq/expert) axes inside one ICI domain, so only the
+// gradient-sync rows (data axis) can ever carry "dcn" — with the slice
+// count the ring spans alongside.
+Json choice_collectives_json(const Choice& c, bool training,
+                             const MeshShape& mesh, const MachineModel& m) {
   Json arr = Json::array();
-  auto add = [&](const char* kind, double bytes, int k, const char* why) {
+  int spans = slices_spanned(mesh, m);
+  auto add = [&](const char* kind, double bytes, int k, const char* why,
+                 bool data_axis) {
     Json o = Json::object();
     o.set("kind", Json(std::string(kind)));
     o.set("bytes", Json(bytes));
     o.set("ring", Json((int64_t)k));
     o.set("cause", Json(std::string(why)));
+    bool dcn = data_axis && spans > 1;
+    o.set("fabric", Json(std::string(dcn ? "dcn" : "ici")));
+    if (dcn) o.set("slices", Json((int64_t)spans));
     arr.push_back(std::move(o));
   };
   if (c.psum_bytes > 0 && c.psum_k > 1)
-    add("allreduce", c.psum_bytes, c.psum_k, "partial_sum");
+    add("allreduce", c.psum_bytes, c.psum_k, "partial_sum", false);
   if (training && c.bwd_psum_bytes > 0 && c.psum_k > 1)
-    add("allreduce", c.bwd_psum_bytes, c.psum_k, "backward_partial_sum");
+    add("allreduce", c.bwd_psum_bytes, c.psum_k, "backward_partial_sum",
+        false);
   if (c.wgather_bytes > 0 && c.psum_k > 1)
-    add("allgather", c.wgather_bytes, c.psum_k, "tiny_batch_weight_gather");
+    add("allgather", c.wgather_bytes, c.psum_k, "tiny_batch_weight_gather",
+        false);
   if (c.gather_bytes > 0 && c.gather_k > 1)
-    add("allgather", c.gather_bytes, c.gather_k, "combine_boundary");
+    add("allgather", c.gather_bytes, c.gather_k, "combine_boundary", false);
   if (c.ring_bytes > 0 && c.ring_k > 1)
-    add("ppermute", c.ring_bytes, c.ring_k, "ring_attention_rotation");
+    add("ppermute", c.ring_bytes, c.ring_k, "ring_attention_rotation",
+        false);
   if (training && c.gradsync_bytes > 0 && c.gradsync_k > 1) {
     if (c.wus) {
       add("allreduce", c.gradsync_bytes, c.gradsync_k,
-          "grad_reduce_scatter");
+          "grad_reduce_scatter", true);
       add("allgather", c.gradsync_bytes, c.gradsync_k,
-          "wus_param_allgather");
+          "wus_param_allgather", true);
     } else {
-      add("allreduce", c.gradsync_bytes, c.gradsync_k, "grad_allreduce");
+      add("allreduce", c.gradsync_bytes, c.gradsync_k, "grad_allreduce",
+          true);
     }
   }
   return arr;
@@ -895,7 +910,8 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
   mem.set("opt_state_bytes", Json(std::max(0.0, pmem - param_b)));
   mem.set("act_bytes", Json(node_act_bytes(n, c, mesh)));
   cj.set("memory", mem);
-  cj.set("collectives", choice_collectives_json(c, cfg.training));
+  cj.set("collectives",
+         choice_collectives_json(c, cfg.training, mesh, m));
   return cj;
 }
 
@@ -1003,6 +1019,11 @@ Json build_search_trace(const Graph& g, const MachineModel& m,
     mt.assign_torus(mesh.dp, mesh.mp, mesh.sp, mesh.ep);
     Json row = Json::object();
     row.set("mesh", mesh_to_json(mesh));
+    // multislice provenance: how many ICI slices this mesh's gradient
+    // ring crosses — the rows a reviewer scans to see which candidates
+    // paid DCN rates for their sync
+    if (m.num_slices > 1)
+      row.set("slices_spanned", Json((int64_t)slices_spanned(mesh, m)));
     auto choices = all_choices(g, mesh, cfg);
     DPResult dp = mesh.pp > 1
         ? frontier_dp(g, choices, mesh, mt, cfg, 0.0, &measured)
@@ -1291,6 +1312,11 @@ Json optimize(const Json& req) {
   meshj.set("expert", Json((int64_t)best.mesh.ep));
   meshj.set("pipe", Json((int64_t)best.mesh.pp));
   out.set("mesh", meshj);
+  // multislice: the winner's gradient ring crosses this many slices
+  // (top-level, NOT inside "mesh" — decode_strategy reads mesh entries
+  // as axis extents). 1 on single-slice machines.
+  out.set("slices_spanned",
+          Json((int64_t)slices_spanned(best.mesh, m)));
   if (best.mesh.pp > 1) {
     Json pj = Json::object();
     pj.set("microbatches", Json((int64_t)best.pipe_microbatches));

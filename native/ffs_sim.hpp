@@ -625,11 +625,16 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   }
   // queue + output buffer: replicated over pipe in the fallback lowering,
   // sharded 1/pp otherwise (plus the in/out stream microbatches); the
-  // circular schedule keeps a stage-0 recirculation buffer of one full
-  // (data-sharded) boundary tensor
+  // circular schedule keeps a stage-0 recirculation buffer — a full
+  // M-slot (data-sharded) boundary tensor in the replicated lowering,
+  // windowed to the M-pp+1 in-flight slots under the sharded queue
+  // (a value banked at tick v+pp-1 is consumed at tick v+M, so at most
+  // M-pp+1 slots are ever live — parallel/pipeline.py's ring buffer)
   double queue_mem =
       2.0 * meta.block_out_bytes / mesh.dp / (qshard ? pp : 1);
-  if (rounds > 1) queue_mem += meta.block_out_bytes / mesh.dp;
+  if (rounds > 1)
+    queue_mem += meta.block_out_bytes / mesh.dp *
+                 (qshard ? (double)(M - pp + 1) / M : 1.0);
   if (qshard)
     queue_mem += 3.0 * meta.block_out_bytes / ((double)M * mesh.dp);
   res.memory = body_param_mem / pp + ht_param_mem +

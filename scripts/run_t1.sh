@@ -31,11 +31,18 @@ if [ -z "${FFS_T1_TRACE_DIR:-}" ]; then
   export FFS_T1_TRACE_DIR=/tmp/_t1_trace
   rm -rf "$FFS_T1_TRACE_DIR"
 fi
+# per-stage wall-clock accounting: every stage appends "name=Ns" to
+# T1_TIMES and the gate prints one "T1 STAGE TIMES" line at the end, so
+# a creeping stage shows up in the log before it eats the 870s budget
+T1_TIMES=""; _t1_mark() { T1_TIMES="$T1_TIMES $1=$(($SECONDS - _t0))s"; _t0=$SECONDS; }; _t0=$SECONDS
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c);
+_t1_mark pytest
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then echo "FFLINT: exit $lint_rc (see FFLINT.json / /tmp/_t1_lint.err)"; else echo "FFLINT: clean (FFLINT.json)"; fi
+_t1_mark lint
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/explain.py --model transformer --out-dir . --trace-dir "$FFS_T1_TRACE_DIR" > /dev/null 2> /tmp/_t1_explain.err; explain_rc=$?
 if [ "$explain_rc" -ne 0 ]; then echo "EXPLAIN: failed (exit $explain_rc, see /tmp/_t1_explain.err) — non-fatal"; else echo "EXPLAIN: written (SEARCH_TRACE.json, EXPLAIN.md)"; fi
+_t1_mark explain
 timeout -k 10 120 python scripts/obs_report.py "$FFS_T1_TRACE_DIR" --out OBS_REPORT.json > /dev/null 2> /tmp/_t1_obs.err; obs_rc=$?
 if [ "$obs_rc" -ne 0 ]; then echo "OBS: report failed (exit $obs_rc, see /tmp/_t1_obs.err) — non-fatal"; else echo "OBS: report written (OBS_REPORT.json)"; fi
 # overlap-fields assert (ISSUE 9, non-fatal like the explain stage): the
@@ -64,6 +71,7 @@ sys.exit(1 if missing else 0)
 EOF
 ovl_rc=$?
 if [ "$ovl_rc" -ne 0 ]; then echo "OBS overlap fields: $(cat /tmp/_t1_ovl.out) — non-fatal"; else echo "OBS overlap fields: ok"; fi
+_t1_mark obs
 # Kernel-search stage (ISSUE 15, non-fatal): the explain stage's
 # SEARCH_TRACE.json must carry per-op KERNEL candidate rows — an impl
 # column (einsum/flash/triad/fused/...) with a cost_source on every
@@ -93,6 +101,7 @@ sys.exit(1 if missing else 0)
 EOF
 kernel_rc=$?
 if [ "$kernel_rc" -ne 0 ]; then echo "KERNEL: $(cat /tmp/_t1_kernel.out) — non-fatal"; else echo "KERNEL: $(cat /tmp/_t1_kernel.out)"; fi
+_t1_mark kernel
 # Elasticity stage (ISSUE 10, non-fatal): the tier-1-fast kill-and-resume
 # leg — 2 processes x 1 device, a host killed mid-epoch via FFS_FAULT,
 # resume from the last complete per-shard checkpoint on the same mesh
@@ -104,6 +113,7 @@ from flexflow_tpu.multihost_dryrun import run_elastic_dryrun
 run_elastic_dryrun(num_processes=2, devices_per_proc=1)
 " > /tmp/_t1_elastic.out 2>&1; elastic_rc=$?
 if [ "$elastic_rc" -ne 0 ]; then echo "ELASTIC: kill/resume leg failed (exit $elastic_rc, see /tmp/_t1_elastic.out) — non-fatal"; else echo "ELASTIC: $(grep -a 'elastic dryrun ok' /tmp/_t1_elastic.out | head -1)"; fi
+_t1_mark elastic
 # Supervision stage (ISSUE 12, non-fatal): supervised kill-and-auto-resume —
 # a real training child runs under runtime_health.Supervisor; a hang trips
 # the --watchdog-timeout (HUNG_EXIT + thread-stack dump), a kill_host dies
@@ -117,6 +127,7 @@ from flexflow_tpu.multihost_dryrun import run_supervised_dryrun
 run_supervised_dryrun()
 " > /tmp/_t1_supervised.out 2>&1; sup_rc=$?
 if [ "$sup_rc" -ne 0 ]; then echo "SUPERVISED: kill/hang auto-resume legs failed (exit $sup_rc, see /tmp/_t1_supervised.out) — non-fatal"; else echo "SUPERVISED: $(grep -a 'supervised dryrun ok' /tmp/_t1_supervised.out | head -1)"; fi
+_t1_mark supervised
 # Costmodel stage (ISSUE 14, non-fatal overall, but schema drift is LOUD):
 # train the learned cost model on the committed fixture corpus, assert
 # COSTMODEL.json materializes with trained classes, and render the
@@ -154,5 +165,20 @@ from flexflow_tpu.serve.loadgen import run_serve_smoke
 run_serve_smoke()
 " > /tmp/_t1_serve.out 2>&1; serve_rc=$?
 if [ "$serve_rc" -ne 0 ]; then echo "SERVE: smoke failed (exit $serve_rc, see /tmp/_t1_serve.out) — non-fatal"; else echo "SERVE: $(grep -a 'serve smoke ok' /tmp/_t1_serve.out | head -1)"; fi
+_t1_mark costmodel_serve
+# Multislice stage (ISSUE 16, non-fatal): 2 slices x 2 processes train
+# over a ('slice', 'data') mesh whose slice axis crosses the process-set
+# boundary — the hierarchical fflint pass (FFL501/502 per slice + FFL503
+# cross-slice leaders) must come back clean, the kill-one-slice fault leg
+# must leave a complete checkpoint whose manifest records the slice axis,
+# and plan_resume's slice_loss plan must resume the survivors through a
+# re-searched strategy within reduction-order tolerance.
+timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" python -c "
+from flexflow_tpu.multihost_dryrun import run_multislice_dryrun
+run_multislice_dryrun(num_slices=2, procs_per_slice=2, devices_per_proc=1)
+" > /tmp/_t1_multislice.out 2>&1; ms_rc=$?
+if [ "$ms_rc" -ne 0 ]; then echo "MULTISLICE: slice-loss dryrun failed (exit $ms_rc, see /tmp/_t1_multislice.out) — non-fatal"; else echo "MULTISLICE: $(grep -a 'multislice dryrun ok' /tmp/_t1_multislice.out | head -1)"; fi
+_t1_mark multislice
+echo "T1 STAGE TIMES:$T1_TIMES total=${SECONDS}s"
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
 exit $rc

@@ -313,6 +313,54 @@ class TestMultihostOrder:
         assert "skipped" in rep.passes["multihost-order"]
 
 
+class TestMultihostOrderPerSlice:
+    """Hierarchical (multi-slice) comparison: ``slice_of_host`` groups
+    the per-host programs into process sets; FFL501/502 fire WITHIN a
+    slice (with slice attribution) and FFL503 fires when the slice
+    LEADERS diverge across the DCN — seeded violations for each."""
+
+    def _ctx(self, texts, slices):
+        ff = small_mlp()
+        return ctx_of(ff, hlo_per_host=texts, slice_of_host=slices)
+
+    def test_clean_two_slices(self):
+        rep = run_passes(self._ctx([HLO_A] * 4, [0, 0, 1, 1]),
+                         [MultihostOrderPass()])
+        assert not rep.diagnostics
+        assert rep.passes["multihost-order"] == "ok"
+
+    def test_within_slice_divergence_names_the_slice(self):
+        # host 3 (slice 1) reorders its collectives: FFL501 attributed
+        # to slice 1, and NO FFL503 (the leaders still agree)
+        rep = run_passes(self._ctx([HLO_A, HLO_A, HLO_A, HLO_B],
+                                   [0, 0, 1, 1]), [MultihostOrderPass()])
+        hits = [d for d in rep.diagnostics if d.rule == "FFL501"]
+        assert hits and "slice 1" in hits[0].message
+        assert not any(d.rule == "FFL503" for d in rep.diagnostics)
+
+    def test_within_slice_count_mismatch_fires_ffl502(self):
+        rep = run_passes(self._ctx([HLO_A, HLO_C, HLO_A, HLO_A],
+                                   [0, 0, 1, 1]), [MultihostOrderPass()])
+        hits = [d for d in rep.diagnostics if d.rule == "FFL502"]
+        assert hits and "slice 0" in hits[0].message
+
+    def test_cross_slice_leader_divergence_fires_ffl503(self):
+        # each slice internally consistent, but slice 1 compiled a
+        # reordered program — the DCN gradient sync would deadlock
+        rep = run_passes(self._ctx([HLO_A, HLO_A, HLO_B, HLO_B],
+                                   [0, 0, 1, 1]), [MultihostOrderPass()])
+        hits = [d for d in rep.diagnostics if d.rule == "FFL503"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert not any(d.rule in ("FFL501", "FFL502")
+                       for d in rep.diagnostics)
+
+    def test_cross_slice_count_mismatch_is_ffl503(self):
+        rep = run_passes(self._ctx([HLO_A, HLO_A, HLO_C, HLO_C],
+                                   [0, 0, 1, 1]), [MultihostOrderPass()])
+        assert any(d.rule == "FFL503" and "collectives" in d.message
+                   for d in rep.diagnostics)
+
+
 class TestGraphHygiene:
     def test_dead_op_fires_ffl601(self):
         ff = FFModel(FFConfig(batch_size=8))

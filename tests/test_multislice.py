@@ -1,0 +1,387 @@
+"""Multi-slice DCN hierarchy (ISSUE 16): the two-level machine model,
+the ('slice', 'data') runtime mesh, hierarchical collective pricing,
+slice-loss resume planning, the fabric-split census — and the
+acceptance search: on a simulated 2 x v4-32 the hierarchical search
+must pick a DP-over-DCN x hybrid-within-slice strategy that prices
+strictly cheaper than the flat-mesh strategy forced onto the same
+chips, with the cross-slice collectives visible in the trace."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.machine import MachineSpec, make_mesh
+from flexflow_tpu.multislice import (MultiSliceSpec, multislice_machine_spec,
+                                     remap_strategy_for_slices, slice_axes,
+                                     slice_of_process, slice_process_groups)
+
+
+class TestMultiSliceSpec:
+    def test_defaults_and_device_count(self):
+        s = MultiSliceSpec()
+        assert s.num_slices == 2 and s.chips_per_slice == 4
+        assert s.num_devices == 8
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MultiSliceSpec(num_slices=0)
+        with pytest.raises(ValueError):
+            MultiSliceSpec(chips_per_slice=0)
+        with pytest.raises(ValueError):
+            MultiSliceSpec(dcn_bw=0.0)
+
+    def test_to_machine_spec_roundtrip(self):
+        s = MultiSliceSpec(num_slices=2, chips_per_slice=32, chip="tpu-v4")
+        m = s.to_machine_spec()
+        assert isinstance(m, MachineSpec)
+        assert m.num_slices == 2 and m.chips_per_slice == 32
+        back = MultiSliceSpec.from_machine_spec(m)
+        assert back.num_slices == 2 and back.chips_per_slice == 32
+
+    def test_slice_of_device(self):
+        s = MultiSliceSpec(num_slices=2, chips_per_slice=4)
+        assert [s.slice_of_device(i) for i in range(8)] == \
+            [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_surviving_drops_slices(self):
+        s = MultiSliceSpec(num_slices=3, chips_per_slice=4)
+        surv = s.surviving([1])
+        assert surv.num_slices == 2 and surv.chips_per_slice == 4
+        with pytest.raises(ValueError):
+            s.surviving([0, 1, 2])  # nobody left
+
+    def test_module_helper(self):
+        m = multislice_machine_spec(8, 2)
+        assert m.num_slices == 2 and m.chips_per_slice == 4
+        with pytest.raises(ValueError):
+            multislice_machine_spec(9, 2)  # not divisible
+
+
+class TestSliceMesh:
+    def test_slice_axes_splits_data_outermost(self):
+        axes = slice_axes({"data": 8, "model": 2}, 2)
+        assert list(axes.items())[0] == ("slice", 2)
+        assert axes == {"slice": 2, "data": 4, "model": 2}
+
+    def test_slice_axes_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            slice_axes({"data": 6}, 4)
+
+    def test_remap_strategy_extends_data_specs(self):
+        from flexflow_tpu.parallel.strategy import OpStrategy, P
+        st = {1: OpStrategy(output_specs=[P("data", None)],
+                            param_specs={"kernel": P(None, "model")})}
+        remap_strategy_for_slices(st)
+        assert st[1].output_specs[0] == P(("slice", "data"), None)
+        assert st[1].param_specs["kernel"] == P(None, "model")
+
+    def test_slice_of_process_contiguous_blocks(self):
+        assert [slice_of_process(p, 4, 2) for p in range(4)] == [0, 0, 1, 1]
+        with pytest.raises(ValueError):
+            slice_of_process(0, 3, 2)
+
+    def test_slice_process_groups(self):
+        assert slice_process_groups(4, 2) == [[0, 1], [2, 3]]
+
+
+class TestHierarchicalPricing:
+    """machine.py's two-level collective pricing: any collective that
+    spans slices pays DCN rates on its cross-slice leg and must price
+    STRICTLY above its single-slice (pure-ICI) twin."""
+
+    def _specs(self):
+        multi = MultiSliceSpec(num_slices=2, chips_per_slice=32,
+                               chip="tpu-v4").to_machine_spec()
+        flat = MachineSpec(chip="tpu-v4", chips_per_slice=64)
+        return multi, flat
+
+    def test_slices_spanned(self):
+        multi, flat = self._specs()
+        assert multi.slices_spanned(32) == 1
+        assert multi.slices_spanned(64) == 2
+        assert flat.slices_spanned(64) == 1
+
+    @pytest.mark.parametrize("kind", ["all-reduce", "reduce-scatter",
+                                      "all-gather", "all-to-all",
+                                      "collective-permute"])
+    def test_dcn_spanning_prices_above_ici_twin(self, kind):
+        multi, flat = self._specs()
+        nbytes = 64e6
+        spanning = multi.collective_time(kind, nbytes, 64)
+        ici_flat = flat.collective_time(kind, nbytes, 64)
+        ici_one_slice = multi.collective_time(kind, nbytes, 32)
+        assert spanning > ici_flat, (kind, spanning, ici_flat)
+        assert spanning > ici_one_slice, (kind, spanning, ici_one_slice)
+
+    def test_dcn_collective_time_scales_with_slices(self):
+        multi, _ = self._specs()
+        t2 = multi.dcn_collective_time("all-reduce", 1e8, 2)
+        t4 = multi.dcn_collective_time("all-reduce", 1e8, 4)
+        assert 0.0 < t2 < t4
+
+    def test_detect_machine_spec_threads_slices(self):
+        from flexflow_tpu.machine import detect_machine_spec
+        spec = detect_machine_spec(8, slices=2)
+        assert spec.num_slices == 2 and spec.chips_per_slice == 4
+        with pytest.raises(ValueError):
+            detect_machine_spec(9, slices=2)
+
+
+class TestPlanResumeSliceLoss:
+    """ckpt/elastic.plan_resume's slice-loss topology class: losing a
+    whole number of slices from a multi-slice checkpoint."""
+
+    def _manifest(self, mesh, n):
+        return {"mesh": mesh, "num_devices": n}
+
+    def test_lost_one_of_two_slices(self):
+        from flexflow_tpu.ckpt import plan_resume
+        plan = plan_resume(self._manifest({"slice": 2, "data": 4}, 8), 4)
+        assert plan["action"] == "research"
+        assert plan["topology"] == "slice_loss"
+        assert plan["lost_slices"] == 1
+        assert plan["surviving_slices"] == 1
+        assert plan["slices"] == 1
+
+    def test_lost_one_of_three_slices_keeps_multislice(self):
+        from flexflow_tpu.ckpt import plan_resume
+        plan = plan_resume(self._manifest({"slice": 3, "data": 6}, 12), 8)
+        assert plan["topology"] == "slice_loss"
+        assert plan["surviving_slices"] == 2 and plan["slices"] == 2
+
+    def test_partial_slice_loss_is_device_change(self):
+        from flexflow_tpu.ckpt import plan_resume
+        # 3 of 8 devices survive: not a whole slice — generic re-search
+        plan = plan_resume(self._manifest({"slice": 2, "data": 4}, 8), 3)
+        assert plan["action"] == "research"
+        assert plan["topology"] == "device_change"
+
+    def test_flat_checkpoint_is_device_change(self):
+        from flexflow_tpu.ckpt import plan_resume
+        plan = plan_resume(self._manifest({"data": 8}, 8), 4)
+        assert plan["action"] == "research"
+        assert plan["topology"] == "device_change"
+
+    def test_same_devices_still_reuses(self):
+        from flexflow_tpu.ckpt import plan_resume
+        plan = plan_resume(self._manifest({"slice": 2, "data": 4}, 8), 8)
+        assert plan["action"] == "reuse"
+        assert "topology" not in plan
+
+
+class TestFabricCensus:
+    """obs/inspect's replica-group parser + ICI/DCN byte attribution."""
+
+    def test_parse_explicit_groups(self):
+        from flexflow_tpu.obs.inspect import parse_replica_groups
+        assert parse_replica_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
+
+    def test_parse_iota_groups(self):
+        from flexflow_tpu.obs.inspect import parse_replica_groups
+        assert parse_replica_groups("[1,8]<=[8]") == [list(range(8))]
+        assert parse_replica_groups("[2,4]<=[8]") == [[0, 1, 2, 3],
+                                                      [4, 5, 6, 7]]
+
+    def test_parse_iota_transpose(self):
+        from flexflow_tpu.obs.inspect import parse_replica_groups
+        # iota(8).reshape(2,4).T.reshape(4,2): strided pairs
+        assert parse_replica_groups("[4,2]<=[2,4]T(1,0)") == \
+            [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_census_splits_by_fabric(self):
+        from flexflow_tpu.obs.inspect import collective_census_by_fabric
+        hlo = "\n".join([
+            # within-slice (devices 0-3 = slice 0): ICI
+            "  %a = f32[1024]{0} all-reduce(%x), replica_groups={{0,1,2,3},{4,5,6,7}}",
+            # spans both slices: DCN
+            "  %b = f32[512]{0} all-gather(%y), replica_groups={{0,4},{1,5},{2,6},{3,7}}",
+            # implicit flat group: conservative DCN
+            "  %c = f32[256]{0} all-reduce(%z)",
+        ])
+        fab = collective_census_by_fabric(hlo, chips_per_slice=4)
+        assert fab["ici"]["count"] == 1 and fab["ici"]["bytes"] == 4096.0
+        assert fab["dcn"]["count"] == 2
+        assert fab["dcn"]["bytes"] == 512 * 4 + 256 * 4
+
+
+class TestRuntimeSliceAxis:
+    """model.compile --slices: the ('slice', 'data') outer mesh axis is
+    numerically transparent — same model, same data, same losses as the
+    flat data mesh on the same 8 virtual devices."""
+
+    def _train(self, slices):
+        import jax
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.optimizers import SGDOptimizer
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        cfg = TransformerConfig(num_layers=1, hidden_size=32, num_heads=2,
+                                seq_length=8, batch_size=16)
+        c = FFConfig(batch_size=cfg.batch_size, seed=3)
+        c.slices = slices
+        ff = create_transformer(cfg, c)
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                   mesh=make_mesh(8, {"data": 8}))
+        rs = np.random.RandomState(0)
+        x = rs.randn(cfg.batch_size, cfg.seq_length,
+                     cfg.hidden_size).astype(np.float32)
+        y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
+        ff.fit(x, y, epochs=2, verbose=False)
+        return ff, float(ff.evaluate(x, y)["loss"])
+
+    def test_sliced_mesh_matches_flat(self):
+        ff_flat, loss_flat = self._train(slices=1)
+        ff_sl, loss_sl = self._train(slices=2)
+        assert dict(zip(ff_flat.mesh.axis_names,
+                        ff_flat.mesh.devices.shape)) == {"data": 8}
+        assert dict(zip(ff_sl.mesh.axis_names,
+                        ff_sl.mesh.devices.shape)) == {"slice": 2,
+                                                       "data": 4}
+        assert loss_sl == pytest.approx(loss_flat, rel=1e-6)
+
+    def test_slices_reject_pipe_mesh(self):
+        import jax
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.ffconst import LossType
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     create_transformer)
+        from flexflow_tpu.optimizers import SGDOptimizer
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2,
+                                seq_length=8, batch_size=8)
+        c = FFConfig(batch_size=cfg.batch_size)
+        c.slices = 2
+        c.pipeline_microbatches = 4
+        ff = create_transformer(cfg, c)
+        with pytest.raises(ValueError, match="pipe"):
+            ff.compile(SGDOptimizer(lr=0.05),
+                       LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+                       mesh=make_mesh(4, {"pipe": 2, "data": 2}))
+
+    def test_config_flag_parses(self):
+        from flexflow_tpu.config import FFConfig
+        c = FFConfig()
+        c.parse_args(["--slices", "2"])
+        assert c.slices == 2
+        with pytest.raises(ValueError):
+            c.parse_args(["--slices", "0"])
+
+
+def _acceptance_requests():
+    """The 2 x v4-32 acceptance fixture: the same tiny strong-scaling
+    transformer serialized once, plus machine JSONs for the two-slice
+    machine and the flat 64-chip machine with identical chips."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 create_transformer)
+    from flexflow_tpu.optimizers import SGDOptimizer
+    from flexflow_tpu.search.unity import machine_to_json, serialize_graph
+
+    n_chips = 64
+    mcfg = TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
+                             seq_length=64, batch_size=n_chips)
+    ff = create_transformer(
+        mcfg, FFConfig(batch_size=mcfg.batch_size, only_data_parallel=True,
+                       workers_per_node=1))
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    nodes = serialize_graph(ff.executor.nodes,
+                            final_guid=ff.executor.final_ref[0])
+    multi = machine_to_json(
+        MultiSliceSpec(num_slices=2, chips_per_slice=32,
+                       chip="tpu-v4").to_machine_spec(), n_chips)
+    flat = machine_to_json(
+        MachineSpec(chip="tpu-v4", chips_per_slice=n_chips), n_chips)
+    cfg = dict(budget=8, alpha=0.05, training=True, overlap=True,
+               batch=mcfg.batch_size, opt_state_factor=2.0, seed=42,
+               rules=[], enable_parameter_parallel=True,
+               emit_search_trace=True)
+    return nodes, multi, flat, cfg
+
+
+@pytest.mark.skipif(
+    not __import__("flexflow_tpu.search.native",
+                   fromlist=["available"]).available(),
+    reason="native search unavailable")
+class TestHierarchicalSearchAcceptance:
+    """ISSUE 16 acceptance: on a simulated 2 x v4-32 the hierarchical
+    search picks a DP/WUS-over-DCN x hybrid-within-slice strategy that
+    prices STRICTLY cheaper than the flat-mesh winner forced onto the
+    same chips, with cross-slice collectives visible in the search
+    trace (slices_spanned mesh rows) and the per-op collective census
+    (fabric="dcn" rows)."""
+
+    @pytest.fixture(scope="class")
+    def results(self):
+        from flexflow_tpu.search.native import native_optimize, native_simulate
+        nodes, multi, flat, cfg = _acceptance_requests()
+        hier = native_optimize(dict(nodes=nodes, machine=multi,
+                                    measured={}, config=cfg))
+        flatw = native_optimize(dict(nodes=nodes, machine=flat,
+                                     measured={}, config=cfg))
+        # force the flat machine's winner onto the two-slice machine:
+        # same chips, same mesh, same per-op choices — but every
+        # data-axis collective now pays DCN rates on its outer leg
+        forced = native_simulate(dict(
+            nodes=nodes, machine=multi, measured={},
+            config=dict(cfg, emit_search_trace=False),
+            mesh=flatw["mesh"],
+            assignment={g: o["choice"]
+                        for g, o in flatw["ops"].items()}))
+        return hier, flatw, forced
+
+    def test_hierarchical_beats_forced_flat(self, results):
+        hier, flatw, forced = results
+        assert hier["predicted_time"] < forced["iteration_time"], (
+            hier["predicted_time"], forced["iteration_time"])
+
+    def test_native_dcn_spanning_prices_above_ici_twin(self, results):
+        # the IDENTICAL mesh + assignment priced on the two-slice
+        # machine (data axis over DCN) vs the flat 64-chip machine
+        # (pure ICI): the native simulator must charge strictly more
+        # when the gradient sync crosses the slice boundary
+        hier, flatw, forced = results
+        assert forced["iteration_time"] > flatw["predicted_time"], (
+            forced["iteration_time"], flatw["predicted_time"])
+
+    def test_hierarchy_shapes_the_mesh(self, results):
+        hier, flatw, _ = results
+        hmesh = {k: v for k, v in hier["mesh"].items() if v > 1}
+        fmesh = {k: v for k, v in flatw["mesh"].items() if v > 1}
+        # the two-level machine steers the search to a different
+        # decomposition than the flat fabric does
+        assert hmesh != fmesh, (hmesh, fmesh)
+        # the winner's inner (non-data) axes fit within one slice, so
+        # only the data axis crosses the DCN
+        inner = 1
+        for a in ("model", "seq", "expert"):
+            inner *= hmesh.get(a, 1)
+        assert inner <= 32 and 32 % inner == 0
+        assert hier.get("slices_spanned", 0) >= 2
+
+    def test_trace_records_slices_spanned(self, results):
+        hier, _, _ = results
+        meshes = hier["search_trace"]["meshes"]
+        rows = [r for r in meshes if r.get("slices_spanned", 0) > 1]
+        assert rows, "no trace rows record a DCN-spanning mesh"
+        # the inner_axes_cross_slice gate rejects meshes whose
+        # model/seq/expert product would straddle the slice boundary
+        assert any(r.get("reason") == "inner_axes_cross_slice"
+                   for r in meshes if r.get("status") == "illegal")
+
+    def test_census_records_dcn_fabric(self, results):
+        hier, _, _ = results
+        fabrics = set()
+        for oj in hier["search_trace"]["ops"]:
+            for cand in oj.get("candidates", []):
+                for row in cand.get("collectives", []):
+                    fabrics.add(row.get("fabric"))
+                    if row.get("fabric") == "dcn":
+                        assert row.get("slices", 0) >= 2
+        assert "dcn" in fabrics, fabrics
+        assert "ici" in fabrics, fabrics
