@@ -38,7 +38,8 @@ from flexflow_tpu.ffconst import (
     PoolType,
 )
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.analysis import LintReport, Severity, lint_model
+from flexflow_tpu.analysis import (EdgeReshard, LintReport, Severity,
+                                   edge_reshard_table, lint_model)
 from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape, Tensor
 from flexflow_tpu.machine import MachineSpec, MachineView
 from flexflow_tpu.model import FFModel
@@ -65,8 +66,10 @@ __all__ = [
     "ParameterSyncType",
     "PoolType",
     "FFConfig",
+    "EdgeReshard",
     "LintReport",
     "Severity",
+    "edge_reshard_table",
     "lint_model",
     "ParallelDim",
     "ParallelTensorShape",

@@ -17,6 +17,12 @@ cross-host collective ordering, and graph hygiene. Entry points:
 Rule catalog: README.md §fflint.
 """
 
+from flexflow_tpu.analysis.dataflow import (EdgeReshard,
+                                            classify_transition,
+                                            edge_reshard_table,
+                                            required_input_specs,
+                                            verify_rewrite_dataflow,
+                                            weight_movement_edges)
 from flexflow_tpu.analysis.diagnostics import (Diagnostic, LintReport,
                                                Severity)
 from flexflow_tpu.analysis.orchestrator import (LintContext, SkipPass,
@@ -32,4 +38,10 @@ __all__ = [
     "all_passes",
     "lint_model",
     "run_passes",
+    "EdgeReshard",
+    "classify_transition",
+    "edge_reshard_table",
+    "required_input_specs",
+    "verify_rewrite_dataflow",
+    "weight_movement_edges",
 ]

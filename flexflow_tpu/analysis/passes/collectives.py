@@ -21,6 +21,30 @@ the simulator never priced. This pass closes the loop in three layers:
    priced set against the emitted census (validate.diff_collectives):
    an emitted kind with no priced coverage is the FFL201 error the
    ROADMAP's "census as a search invariant" item asks for.
+
+Since the edge-level dataflow pass (analysis/dataflow.py) the *Infer*
+layer is edge-attributed, not kind-aggregated: every producer→consumer
+spec disagreement contributes its exact implied collective (kind,
+per-device bytes, mesh axes, fabric) to the inferred set, and the
+rules that used to be heuristic became exact:
+
+* FFL205 is an ERROR — an implicit edge reshard nothing prices,
+  named ``producer.out[i] -> consumer.in[j]`` with the spec pair and
+  bytes (no simulator replay needed);
+* FFL210 (ERROR) — an implicit edge reshard whose kind the simulator
+  replay priced zero bytes for: the search ranked this strategy blind
+  to an edge cost it provably carries;
+* FFL211 (WARNING) — two implicit reshards on one chain that compose
+  to a round trip (resharded into a layout and straight back out);
+* FFL212 (WARNING) — a large output materialized replicated although
+  every consumer immediately shards it;
+* FFL213 (ERROR) — an accepted substitution rewrite whose post-rewrite
+  edge-spec map implies MORE collective bytes than the pre-rewrite map
+  (dataflow.verify_rewrite_dataflow, recorded by graph_optimize).
+
+The tiny-batch weight-movement special case is gone: the general rule
+(dataflow.weight_movement_edges) derives the weight all-gather from
+spec + shape for any row-parallel contraction.
 """
 
 from __future__ import annotations
@@ -29,6 +53,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from flexflow_tpu.analysis.dataflow import (edge_reshard_table,
+                                            weight_movement_edges)
 from flexflow_tpu.analysis.diagnostics import (Diagnostic, error, info,
                                                warning)
 from flexflow_tpu.ffconst import CompMode, OperatorType
@@ -67,8 +93,14 @@ def _node_param_specs(node, ctx) -> Dict[str, Any]:
     return st.param_specs if st is not None else {}
 
 
-def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
-    """{kind: {bytes, sources: [op names]}} the strategy implies.
+def infer_strategy_collectives(ctx, edge_table=None,
+                               weight_moves=None) -> Dict[str, Dict[str, Any]]:
+    """{kind: {bytes, sources: [op names], edges: [...]}} the strategy
+    implies. Edge-attributed: node-local terms (grad sync, psum,
+    explicit parallel-op boundaries, rings, pipeline hops) carry their
+    op name as the source; implicit producer→consumer reshards carry
+    the full edge (``a.out[i] -> b.in[j]`` plus spec pair) under the
+    ``edges`` key so a diagnostic can name the exact seam.
 
     Bytes are per-device payloads (the census convention): an
     all-reduce of a replicated gradient moves the full tensor per
@@ -79,12 +111,14 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
     axis_sizes = ctx.axis_sizes
     out: Dict[str, Dict[str, Any]] = {}
 
-    def add(kind: str, nbytes: float, src: str):
+    def add(kind: str, nbytes: float, src: str, edge=None):
         if nbytes < _MIN_BYTES:
             return
-        e = out.setdefault(kind, dict(bytes=0.0, sources=[]))
+        e = out.setdefault(kind, dict(bytes=0.0, sources=[], edges=[]))
         e["bytes"] += nbytes
         e["sources"].append(src)
+        if edge is not None:
+            e["edges"].append(edge.to_json())
 
     elem = 4.0
     training = True
@@ -230,6 +264,23 @@ def infer_strategy_collectives(ctx) -> Dict[str, Dict[str, Any]]:
         hops = ticks * (3.0 if qshard else 1.0) + (pp - 1 if qshard else 0)
         add("ppermute", hops * hop * (2.0 if training else 1.0),
             "pipeline:hop")
+    # implicit GSPMD reshards at producer→consumer spec disagreements:
+    # the edge table is the general rule (explicit parallel-op
+    # boundaries and pipe hops were already priced above; pure
+    # additional slicing moves nothing)
+    if edge_table is None:
+        edge_table = edge_reshard_table(ctx)
+    for e in edge_table:
+        if e.explicit or e.kind == "slice":
+            continue
+        add(e.kind, e.bytes, f"{e.edge}:edge", edge=e)
+    # tiny-batch weight movement, generalized: row-parallel
+    # contractions whose per-chip row count fits one MXU tile resolve
+    # by all-gathering the model-sharded weight
+    if weight_moves is None:
+        weight_moves = weight_movement_edges(ctx)
+    for e in weight_moves:
+        add(e.kind, e.bytes, f"{e.producer}:weight-move", edge=e)
     return out
 
 
@@ -432,11 +483,123 @@ class CollectiveInferencePass:
                          "predictions for this op are optimistic"))
         return out
 
+    # replicated outputs below this are cheap enough to materialize
+    # everywhere without comment (FFL212)
+    REPLICATED_MAT_BYTES = float(1 << 16)
+
+    def _redundant_pairs(self, ctx, implicit) -> List[Diagnostic]:
+        """FFL211 (WARNING): two implicit reshards on one chain whose
+        specs compose to a round trip — the tensor is resharded into an
+        intermediate layout and straight back out, so either the
+        interior op's spec is wrong or the pair should cancel."""
+        out: List[Diagnostic] = []
+        by_consumer: Dict[int, list] = {}
+        for e in implicit:
+            if e.in_idx >= 0:
+                by_consumer.setdefault(e.consumer_guid, []).append(e)
+        for e2 in implicit:
+            if e2.in_idx < 0:
+                continue
+            for e1 in by_consumer.get(e2.producer_guid, ()):
+                if e1.src_spec == e2.dst_spec \
+                        and e1.dst_spec == e2.src_spec:
+                    out.append(warning(
+                        "FFL211",
+                        f"redundant reshard pair: '{e1.edge}' then "
+                        f"'{e2.edge}' compose to a round trip "
+                        f"({e1.bytes / 1e6:.2f} + {e2.bytes / 1e6:.2f} "
+                        f"MB moved to end where it started)",
+                        op=e1.consumer, tensor=f"out[{e2.out_idx}]",
+                        hint=f"give '{e1.consumer}' the producer's "
+                             f"layout (or let it follow) so neither "
+                             f"reshard is needed"))
+        return out
+
+    def _replicated_materializations(self, ctx, table) -> List[Diagnostic]:
+        """FFL212 (WARNING): a large compute-op output materialized
+        fully replicated although every consumer immediately shards it
+        — the op burns replicated FLOPs and memory to produce data
+        each device then throws most of away; shard at the producer."""
+        out: List[Diagnostic] = []
+        try:
+            cons = ctx.consumers()
+        except Exception:
+            cons = None
+        elem = 4.0
+        if ctx.ff is not None and ctx.ff.executor is not None:
+            elem = float(np.dtype(ctx.ff.executor.compute_dtype).itemsize)
+        by_out: Dict[tuple, list] = {}
+        for e in table:
+            if e.in_idx >= 0:
+                by_out.setdefault((e.producer_guid, e.out_idx),
+                                  []).append(e)
+        for (guid, idx), edges in sorted(by_out.items()):
+            if not all(e.kind == "slice" and not e.explicit
+                       for e in edges):
+                continue
+            if any(x is not None for x in edges[0].src_spec):
+                continue  # producer output is sharded already
+            node = ctx.by_guid.get(guid)
+            if node is None or getattr(node.op, "is_parallel_op", False):
+                continue
+            if node.op.op_type in (OperatorType.NOOP, OperatorType.CONST):
+                continue
+            gbytes = float(np.prod(node.op.output_shapes[idx])) * elem
+            if gbytes < self.REPLICATED_MAT_BYTES:
+                continue
+            if cons is not None \
+                    and len(edges) < len(cons.get((guid, idx), ())):
+                continue  # some consumer really wants it replicated
+            names = ", ".join(sorted({e.consumer for e in edges})[:4])
+            out.append(warning(
+                "FFL212",
+                f"'{node.op.name}' materializes out[{idx}] "
+                f"({gbytes / 1e6:.2f} MB) replicated but every consumer "
+                f"({names}) shards it",
+                op=node.op.name, tensor=f"out[{idx}]",
+                hint="shard the producer's output spec to the "
+                     "consumers' layout — replicated compute and "
+                     "memory are being thrown away"))
+        return out
+
+    def _rewrite_verification(self, ctx) -> List[Diagnostic]:
+        """FFL213 (ERROR): graph_optimize accepted a substitution
+        rewrite whose post-rewrite edge-spec map implies MORE implicit
+        collective bytes than the pre-rewrite map — the rewrite won on
+        the simulator's op-local terms while opening a reshard seam the
+        static dataflow can see (dataflow.verify_rewrite_dataflow,
+        recorded in search_info['rewrite_verification'])."""
+        ff = ctx.ff
+        if ff is None or not isinstance(getattr(ff, "search_info", None),
+                                        dict):
+            return []
+        rv = ff.search_info.get("rewrite_verification")
+        if not rv or rv.get("ok", True):
+            return []
+        out: List[Diagnostic] = []
+        for f in rv.get("findings", ()):
+            where = f" (worst edge '{f['edge']}', {f['src_spec']} -> " \
+                    f"{f['dst_spec']})" if f.get("edge") else ""
+            out.append(error(
+                "FFL213",
+                f"accepted rewrite regressed the edge-reshard map: "
+                f"implicit {f['kind']} bytes "
+                f"{f['pre_bytes'] / 1e6:.2f} -> "
+                f"{f['post_bytes'] / 1e6:.2f} MB{where}",
+                hint="the substitution won on op-local simulated terms "
+                     "but introduced a reshard seam — reject the "
+                     "rewrite or re-search with it pinned off"))
+        return out
+
     def run(self, ctx) -> List[Diagnostic]:
         diags: List[Diagnostic] = []
         diags.extend(self._overlap_rejections(ctx))
         diags.extend(self._kernel_choice_checks(ctx))
-        inferred = infer_strategy_collectives(ctx)
+        diags.extend(self._rewrite_verification(ctx))
+        table = edge_reshard_table(ctx)
+        wmoves = weight_movement_edges(ctx)
+        inferred = infer_strategy_collectives(ctx, edge_table=table,
+                                              weight_moves=wmoves)
         priced: Optional[Dict[str, float]] = None
         try:
             priced = ctx.ensure_priced()
@@ -451,6 +614,53 @@ class CollectiveInferencePass:
                 hint="the priced-vs-inferred diff did not run — fix the "
                      "replay before trusting this strategy's prediction"))
         emitted = ctx.ensure_emitted()
+
+        # edge-level rules: every implicit producer→consumer reshard
+        # must be PRICED (searched or replayed) — an edge cost nothing
+        # accounted for means the strategy was ranked blind to it
+        implicit = [e for e in table
+                    if not e.explicit and e.kind in ("allgather",
+                                                     "reshard")
+                    and e.bytes >= _MIN_BYTES]
+        implicit += [e for e in wmoves if e.bytes >= _MIN_BYTES]
+        if priced is not None:
+            for e in implicit:
+                pb = sum(priced.get(k, 0.0)
+                         for k in _COVER.get(e.kind, {e.kind}))
+                if pb <= 0:
+                    diags.append(error(
+                        "FFL210",
+                        f"unpriced edge reshard: '{e.edge}' "
+                        f"({_fmt_spec(e.src_spec)} -> "
+                        f"{_fmt_spec(e.dst_spec)}) implies a "
+                        f"{e.kind} of {e.bytes / 1e6:.2f} MB over "
+                        f"{list(e.axes)} ({e.fabric}) the simulator "
+                        f"priced zero bytes for",
+                        op=e.consumer, tensor=f"in[{e.in_idx}]"
+                        if e.in_idx >= 0 else "param[kernel]",
+                        hint="the native cost model replayed this "
+                             "strategy without charging the edge — its "
+                             "ranking is unreliable here"))
+        elif not getattr(ctx, "searched", False):
+            # no replay and no search: nothing has EVER priced these
+            # edges — the exact failure mode FFL205 exists for, now
+            # named per edge instead of guessed from the HLO census
+            for e in implicit:
+                diags.append(error(
+                    "FFL205",
+                    f"implicit edge reshard nothing prices: '{e.edge}' "
+                    f"({_fmt_spec(e.src_spec)} -> "
+                    f"{_fmt_spec(e.dst_spec)}) implies a {e.kind} of "
+                    f"{e.bytes / 1e6:.2f} MB over {list(e.axes)} "
+                    f"({e.fabric})",
+                    op=e.consumer, tensor=f"in[{e.in_idx}]"
+                    if e.in_idx >= 0 else "param[kernel]",
+                    hint="GSPMD will insert this collective at the "
+                         "spec seam — search the strategy (or price "
+                         "it via the simulator) before trusting any "
+                         "prediction for this model"))
+        diags.extend(self._redundant_pairs(ctx, implicit))
+        diags.extend(self._replicated_materializations(ctx, table))
 
         if priced is not None:
             # inferred kind the simulator never charged: the search
@@ -489,16 +699,26 @@ class CollectiveInferencePass:
                              "beyond tolerance — recalibrate "
                              "(scripts/calibrate.py)"))
         elif emitted is not None:
-            # no simulator: the static inference is the only priced-side
-            # proxy; an emitted kind it cannot explain is still suspect
+            # no simulator: the static inference (node terms + the
+            # edge table) is the only priced-side proxy; an emitted
+            # kind it cannot explain means GSPMD inserted movement the
+            # dataflow never derived — since edge-level inference that
+            # is an ERROR, not a shrug
             for kind, eb in emitted.items():
                 ib = sum(inferred.get(k, {}).get("bytes", 0.0)
                          for k in _COVER.get(kind, {kind}))
                 if ib <= 0:
-                    diags.append(warning(
+                    diags.append(error(
                         "FFL205",
                         f"emitted {kind} ({eb / 1e6:.2f} MB) matches no "
-                        f"statically-inferred collective",
-                        hint="run with the native simulator available "
-                             "for the authoritative priced diff"))
+                        f"statically-inferred collective (node terms or "
+                        f"edge reshards)",
+                        hint="the edge-level dataflow cannot explain "
+                             "this movement — a transfer rule is "
+                             "missing or the strategy file is stale"))
         return diags
+
+
+def _fmt_spec(entries) -> str:
+    from flexflow_tpu.analysis.dataflow import _spec_str
+    return _spec_str(entries)

@@ -123,7 +123,7 @@ class ShardingLegalityPass:
                     break
                 diags.extend(_check_spec(
                     spec, op.output_shapes[i], axis_sizes, op.name,
-                    op.guid, f"output[{i}]"))
+                    op.guid, f"out[{i}]"))
             param_specs = getattr(node, "param_specs", None)
             if not param_specs and st is not None:
                 param_specs = st.param_specs
@@ -254,14 +254,14 @@ class ShardingLegalityPass:
                     "FFL104",
                     f"repartition over mesh axis {ax!r} but the mesh "
                     f"carries {sorted(axis_sizes)}",
-                    op=op.name, guid=op.guid,
+                    op=op.name, guid=op.guid, tensor="out[0]",
                     hint="pass repartition(axis=...) naming a real axis"))
             elif op.repartition_degree != axis_sizes[ax]:
                 diags.append(error(
                     "FFL104",
                     f"repartition degree {op.repartition_degree} != mesh "
                     f"axis {ax!r} extent {axis_sizes[ax]}",
-                    op=op.name, guid=op.guid,
+                    op=op.name, guid=op.guid, tensor="out[0]",
                     hint="under GSPMD the degree must equal the axis "
                          "extent it maps to"))
         elif t == OperatorType.COMBINE:
@@ -274,7 +274,7 @@ class ShardingLegalityPass:
                         "FFL104",
                         f"combine(dim={d}) of an input not sharded on "
                         f"that dim — the op is a no-op",
-                        op=op.name, guid=op.guid,
+                        op=op.name, guid=op.guid, tensor="in[0]",
                         hint="dead resharding; drop the combine or fix "
                              "the upstream repartition dim"))
         elif t == OperatorType.REDUCTION:
@@ -295,7 +295,7 @@ class ShardingLegalityPass:
                         f"reduction(dim={d}, degree="
                         f"{op.reduction_degree}) over a dim sharded "
                         f"{degree}-way",
-                        op=op.name, guid=op.guid,
+                        op=op.name, guid=op.guid, tensor="in[0]",
                         hint="the reduction degree must equal the "
                              "replica count laid out on that dim"))
         return diags

@@ -490,6 +490,19 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
     if new_nodes is not nodes:
         info["rewritten_nodes"] = new_nodes
         info["final_ref"] = new_final
+        # static rewrite verification (FFL213): the accepted rewrite's
+        # post-rewrite edge-spec map must be collective-equivalent-or-
+        # cheaper than the pre-rewrite map under the same strategy —
+        # a substitution that wins on op-local simulated terms while
+        # opening a reshard seam is caught here, before compile
+        from flexflow_tpu.analysis.dataflow import verify_rewrite_dataflow
+        try:
+            info["rewrite_verification"] = verify_rewrite_dataflow(
+                nodes, new_nodes, strategy, dict(mesh_axes),
+                rewrites=resp.get("rewrites", []))
+        except Exception as e:  # never let verification break the search
+            info["rewrite_verification"] = dict(
+                ok=True, findings=[], error=repr(e))
     return mesh_axes, strategy, info
 
 

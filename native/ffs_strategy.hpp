@@ -334,6 +334,26 @@ inline double sharded_param_bytes(const Node& n, const Choice& c,
   return b;
 }
 
+// Tiny-batch weight movement — ONE rule for every row-parallel
+// contraction (Linear, Conv2D, anything whose kernel shards the
+// contraction dim): with at most one MXU tile edge (128) of output rows
+// per data shard and an output smaller than its weight, GSPMD resolves
+// the contraction by moving the WEIGHT — all-gather of the model-sharded
+// kernel forward (once), all-reduce of the weight gradient backward
+// (searched XDL emitted 7x the priced bytes before this term existed,
+// fflint FFL202 / ROADMAP). At real batch sizes the term self-gates off.
+// Mirrored exactly by analysis/dataflow.weight_movement_edges — the
+// static edge rule and this priced term must agree or the census-parity
+// test (tests/test_dataflow.py) fails.
+inline void tiny_batch_weight_movement(Choice& c, const Node& n,
+                                       double rows, int eff_dp) {
+  if (rows > 0 && eff_dp > 0 && rows / eff_dp <= 128.0 &&
+      (double)n.output_bytes(0) < pbytes(n)) {
+    c.wgather_bytes += pbytes(n);
+    c.bwd_psum_bytes += pbytes(n);
+  }
+}
+
 }  // namespace detail
 
 // Enumerate the legal sharding choices of `n` on mesh (dp, mp).
@@ -445,21 +465,11 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
-        // tiny-batch regime: with fewer output rows per chip than one
-        // MXU tile edge, GSPMD resolves the row-parallel matmul by
-        // moving the WEIGHT — all-gather of the row-sharded kernel
-        // forward (once), all-reduce of the weight gradient backward
-        // (searched XDL emitted 7x the priced bytes this way, fflint
-        // FFL202 / ROADMAP). Rows = all output dims but the last (a
-        // [B,S,E] Linear runs B*S MXU rows, not B); at real batch sizes
-        // the term self-gates off.
+        // Rows = all output dims but the last (a [B,S,E] Linear runs
+        // B*S MXU rows, not B).
         double rows = oshp.empty()
             ? 0.0 : (double)shape_elems(oshp) / oshp.back();
-        if (rows > 0 && rows / eff_dp <= 128.0 &&
-            (double)n.output_bytes(0) < detail::pbytes(n)) {
-          c.wgather_bytes += detail::pbytes(n);
-          c.bwd_psum_bytes += detail::pbytes(n);
-        }
+        detail::tiny_batch_weight_movement(c, n, rows, eff_dp);
         out.push_back(std::move(c));
       }
     }
@@ -528,18 +538,13 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
         c.work_div = static_cast<double>(eff_dp) * mp;
         c.gradsync_bytes = detail::pbytes(n) / mp;
         c.gradsync_k = eff_dp;
-        // tiny-batch weight movement, as in the row-parallel Linear:
-        // kernel all-gather fwd (once) + weight-grad all-reduce bwd.
-        // Conv MXU rows = N*H*W of the output.
+        // Conv MXU rows = N*H*W of the output (channel is the
+        // contraction's free dim).
         double rows = n.output_shapes[0].size() == 4
             ? (double)(n.output_shapes[0][0] * n.output_shapes[0][2] *
                        n.output_shapes[0][3])
             : (double)batch;
-        if (rows > 0 && rows / eff_dp <= 128.0 &&
-            (double)n.output_bytes(0) < detail::pbytes(n)) {
-          c.wgather_bytes += detail::pbytes(n);
-          c.bwd_psum_bytes += detail::pbytes(n);
-        }
+        detail::tiny_batch_weight_movement(c, n, rows, eff_dp);
         out.push_back(std::move(c));
       }
     }

@@ -404,6 +404,41 @@ def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
                 f"compared) and both models rank the same winner "
                 f"everywhere — the learned model refines magnitudes "
                 f"without flipping any choice on this graph.")
+    edge_rows = []
+    try:
+        edge_rows = _fflint().edge_table_json(ff)
+    except Exception:
+        pass  # edge table is best-effort; the rest of the report stands
+    if edge_rows:
+        implicit = [r for r in edge_rows
+                    if not r["explicit"] and not r.get("weight_movement")]
+        lines += [
+            "",
+            f"## Per-edge reshard table ({len(edge_rows)} edges, "
+            f"{len(implicit)} implicit)",
+            "",
+            "Every producer→consumer edge whose tensor arrives under a "
+            "different PartitionSpec than the consumer requires, and the "
+            "collective GSPMD inserts to fix it (per-device bytes). "
+            "`implicit` edges are the compiler's insertions; `explicit` "
+            "edges cross a parallel-op boundary the graph already "
+            "prices; `wmove` rows are the generalized tiny-batch "
+            "weight-movement rule (gather the kernel instead of "
+            "resharding a tiny activation).",
+            "",
+            "| edge | src spec | dst spec | kind | MB | axes | fabric |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in edge_rows[:30]:
+            tag = ("wmove" if r.get("weight_movement")
+                   else "explicit" if r["explicit"] else "implicit")
+            lines.append(
+                f"| `{r['edge']}` ({tag}) | `{r['src_spec']}` | "
+                f"`{r['dst_spec']}` | {r['kind']} | "
+                f"{r['bytes'] / 1e6:.3f} | "
+                f"{'+'.join(r['axes']) or '-'} | {r['fabric']} |")
+        if len(edge_rows) > 30:
+            lines.append(f"| … {len(edge_rows) - 30} more | | | | | | |")
     lines += [
         "",
         f"## Simulated timeline path (first {len(path_rows)} of "

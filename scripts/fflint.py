@@ -11,6 +11,14 @@ any ERROR-severity diagnostic fires.
     python scripts/fflint.py --model transformer --budget 4 --hlo
     python scripts/fflint.py --all --json > fflint.json
     python scripts/fflint.py --model resnet --layout nhwc --lint-out out.json
+    python scripts/fflint.py --model llama --budget 4 --edges
+
+``--edges`` additionally renders the per-edge reshard table
+(analysis/dataflow.py): every producer→consumer spec disagreement with
+the collective it implies — kind, per-device bytes, mesh axes, fabric
+(ici|dcn) — plus the generalized tiny-batch weight-movement edges. With
+``--json`` the table lands under ``edge_reshards``; the exit code is
+nonzero whenever an unpriced edge fires FFL205/FFL210 (ERROR).
 
 ``--model all`` / ``--all`` sweeps every zoo model and merges the
 reports into one JSON document keyed by model name (the artifact the
@@ -121,6 +129,37 @@ def compile_model(ff, loss_kind: str):
     return ff
 
 
+def edge_table_json(ff) -> list:
+    """The per-edge reshard table of the compiled model, as JSON rows —
+    implicit GSPMD insertions first, then explicit boundaries, then the
+    generalized tiny-batch weight-movement edges."""
+    from flexflow_tpu.analysis import (LintContext, edge_reshard_table,
+                                       weight_movement_edges)
+    ctx = LintContext(
+        nodes=ff.executor.nodes, mesh=ff.mesh, strategy=ff.strategy,
+        machine_spec=ff.machine_spec, config=ff.config,
+        final_ref=ff.executor.final_ref, ff=ff)
+    rows = [e.to_json() for e in
+            sorted(edge_reshard_table(ctx),
+                   key=lambda e: (e.explicit, -e.bytes))]
+    rows += [dict(e.to_json(), weight_movement=True)
+             for e in weight_movement_edges(ctx)]
+    return rows
+
+
+def format_edges(rows: list) -> str:
+    lines = []
+    for r in rows:
+        tag = ("wmove" if r.get("weight_movement")
+               else "explicit" if r["explicit"] else "implicit")
+        lines.append(
+            f"  {tag:<8} {r['edge']}  {r['src_spec']} -> {r['dst_spec']}"
+            f"  {r['kind']} {r['bytes'] / 1e6:.3f} MB"
+            f" [{'+'.join(r['axes']) or '-'}/{r['fabric']}]"
+            + (f" ({r['reason']})" if r.get("reason") else ""))
+    return "\n".join(lines) if lines else "  (no edge reshards)"
+
+
 def lint_one(name: str, args) -> "LintReport":
     from flexflow_tpu.analysis import lint_model
     from flexflow_tpu.config import FFConfig
@@ -134,6 +173,8 @@ def lint_one(name: str, args) -> "LintReport":
     compile_model(ff, loss_kind)
     report = lint_model(ff, hlo=True if args.hlo else None)
     report.context["model"] = name
+    if getattr(args, "edges", False):
+        report.context["edge_reshards"] = edge_table_json(ff)
     return report
 
 
@@ -151,6 +192,10 @@ def main() -> int:
     ap.add_argument("--budget", type=int, default=0,
                     help="search budget: lint the SEARCHED strategy "
                          "instead of the data-parallel default")
+    ap.add_argument("--edges", action="store_true",
+                    help="include the per-edge reshard table (kind, "
+                         "bytes, axes, fabric per producer->consumer "
+                         "spec disagreement)")
     ap.add_argument("--layout", default="auto",
                     choices=["auto", "nhwc", "nchw"],
                     help="conv compute layout for the layout pass")
@@ -175,8 +220,12 @@ def main() -> int:
         if report.has_errors():
             rc = rc or 1
         if not args.json:
+            edges = report.context.pop("edge_reshards", None)
             print(f"== {name}")
             print(report.format_human())
+            if edges is not None:
+                print(f"-- edge reshard table ({len(edges)} edges)")
+                print(format_edges(edges))
     doc = merged if len(models) > 1 else merged[models[0]]
     if args.json:
         print(json.dumps(doc, indent=1))

@@ -8,7 +8,9 @@
 # & graph verifier, flexflow_tpu/analysis) over the whole model zoo and
 # writes the JSON report to FFLINT.json next to the bench artifacts.
 # Lint ERRORs fail the gate only when the tests themselves passed, so a
-# test regression is never masked by a lint exit code.
+# test regression is never masked by a lint exit code. The report now
+# carries per-edge reshard diagnostics (--edges), and a baseline gate
+# fails the stage on any FFL2xx ERROR not in the committed FFLINT.json.
 #
 # An explain stage runs scripts/explain.py over one zoo model, emitting
 # SEARCH_TRACE.json + EXPLAIN.md (search provenance: per-mesh candidates
@@ -37,8 +39,44 @@ fi
 T1_TIMES=""; _t1_mark() { T1_TIMES="$T1_TIMES $1=$(($SECONDS - _t0))s"; _t0=$SECONDS; }; _t0=$SECONDS
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c);
 _t1_mark pytest
-timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/fflint.py --all --json --edges --lint-out FFLINT.json > /dev/null 2> /tmp/_t1_lint.err; lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then echo "FFLINT: exit $lint_rc (see FFLINT.json / /tmp/_t1_lint.err)"; else echo "FFLINT: clean (FFLINT.json)"; fi
+# Edge-diagnostic baseline gate (ISSUE 18): FFLINT.json now carries the
+# per-edge reshard tables (--edges) and the FFL2xx census rules are
+# edge-attributed ERRORs. Any FFL2xx ERROR that is NOT in the committed
+# baseline (HEAD's FFLINT.json) fails the lint stage — pre-existing
+# accepted findings don't, so the gate only catches regressions.
+git show HEAD:FFLINT.json > /tmp/_t1_fflint_base.json 2>/dev/null || echo '{}' > /tmp/_t1_fflint_base.json
+timeout -k 10 60 python - > /tmp/_t1_edge.out 2>&1 <<'EOF'
+import json, sys
+def ffl2_errors(doc):
+    out = set()
+    if not isinstance(doc, dict):
+        return out
+    # merged doc: model -> report; single report has "diagnostics" at top
+    reports = (doc.items() if "diagnostics" not in doc
+               else [(doc.get("context", {}).get("model", "?"), doc)])
+    for name, rep in reports:
+        if not isinstance(rep, dict):
+            continue
+        for d in rep.get("diagnostics") or []:
+            if (d.get("severity") == "error"
+                    and str(d.get("rule", "")).startswith("FFL2")):
+                out.add((name, d.get("rule"), d.get("op"), d.get("tensor")))
+    return out
+new = ffl2_errors(json.load(open("FFLINT.json")))
+try:
+    base = ffl2_errors(json.load(open("/tmp/_t1_fflint_base.json")))
+except Exception:
+    base = set()
+fresh = sorted(new - base, key=str)
+for f in fresh:
+    print(f"NEW FFL2xx ERROR vs committed baseline: {f}")
+print(f"{len(new)} FFL2xx error(s), {len(fresh)} new vs baseline")
+sys.exit(1 if fresh else 0)
+EOF
+edge_rc=$?
+if [ "$edge_rc" -ne 0 ]; then echo "FFLINT edge baseline: $(tail -1 /tmp/_t1_edge.out) (see /tmp/_t1_edge.out)"; else echo "FFLINT edge baseline: $(tail -1 /tmp/_t1_edge.out)"; fi
 _t1_mark lint
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/explain.py --model transformer --out-dir . --trace-dir "$FFS_T1_TRACE_DIR" > /dev/null 2> /tmp/_t1_explain.err; explain_rc=$?
 if [ "$explain_rc" -ne 0 ]; then echo "EXPLAIN: failed (exit $explain_rc, see /tmp/_t1_explain.err) — non-fatal"; else echo "EXPLAIN: written (SEARCH_TRACE.json, EXPLAIN.md)"; fi
@@ -181,4 +219,5 @@ if [ "$ms_rc" -ne 0 ]; then echo "MULTISLICE: slice-loss dryrun failed (exit $ms
 _t1_mark multislice
 echo "T1 STAGE TIMES:$T1_TIMES total=${SECONDS}s"
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then exit 3; fi
+if [ "$rc" -eq 0 ] && [ "$edge_rc" -ne 0 ]; then exit 3; fi
 exit $rc

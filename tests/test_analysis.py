@@ -5,7 +5,10 @@ degree, unpriced collective, mismatched host order, bf16 statistics,
 redundant transposes, dead ops — with the rule id and severity the
 README catalog promises, plus a clean-model no-diagnostics case and the
 compile-time wiring (``compile(lint="error")`` rejects an illegal
-imported strategy before any parameter is allocated).
+imported strategy before any parameter is allocated). Diagnostics carry
+tensor-level anchors (``out[i]`` / ``in[j]`` / ``param:name``) so a rule
+points at the offending tensor, not just the op; the edge-level
+collective rules (FFL205/210-213) are exercised in tests/test_dataflow.py.
 """
 
 import json
@@ -81,6 +84,8 @@ class TestShardingLegality:
         hits = [d for d in diags if d.rule == "FFL101"]
         assert hits and hits[0].severity == Severity.ERROR
         assert "not divisible" in hits[0].message
+        # diagnostics anchor the offending TENSOR, not just the op
+        assert hits[0].tensor == "out[0]"
 
     def test_unknown_axis_fires_ffl102(self):
         ff = small_mlp()
@@ -107,6 +112,7 @@ class TestShardingLegality:
         hits = [d for d in diags if d.rule == "FFL104"]
         assert hits and hits[0].severity == Severity.ERROR
         assert "repartition" in hits[0].message
+        assert hits[0].tensor == "out[0]"
 
 
 class TestCollectiveInference:

@@ -109,8 +109,14 @@ class TestCensusInvariant:
     EVERY zoo model, the searched strategy's statically-inferred
     collective set must be covered by the set the native simulator
     priced (fflint collective-inference pass, FFL204/FFL201 are
-    ERROR-severity). A model whose searched strategy implies data
-    movement the search never costed fails CI here, not on the chip."""
+    ERROR-severity). Since the edge-level dataflow (analysis/dataflow.py)
+    the inference is per-edge, so this is also the zoo-wide
+    "every searched strategy lints EDGE-clean" invariant: any implicit
+    producer→consumer reshard the replay priced zero bytes for is an
+    FFL210 ERROR, and an accepted substitution rewrite that regressed
+    the edge-spec map is an FFL213 ERROR — both fail here. A model
+    whose searched strategy implies data movement the search never
+    costed fails CI here, not on the chip."""
 
     # inception is the slowest twin (~36s, 5x the next) and the
     # invariant is per-model-identical; tier-1 keeps the other four.
@@ -153,7 +159,15 @@ class TestCensusByteDrift:
     the col-bwd-AR, replicated-scatter-grad, and tiny-batch
     weight-movement terms priced (native/ffs_strategy.hpp), the searched
     strategies' emitted census must sit within the 3x byte tolerance of
-    the priced set: no under-priced kind survives."""
+    the priced set: no under-priced kind survives.
+
+    ISSUE 18 closes the PR 3 follow-on: the EDGE-level bytes
+    (analysis/dataflow.py — per producer→consumer spec disagreement)
+    are now the reference. Per kind, the native-priced set must cover
+    the statically-inferred edge bytes, so the embedding/conv
+    all-gather underpricing the census pass found cannot silently
+    reopen: a native pricing term that drops below the edge-derived
+    lower bound fails here as drift."""
 
     def _drift(self, name):
         from flexflow_tpu.search.native import available
@@ -166,7 +180,8 @@ class TestCensusByteDrift:
         cfg.enable_pipeline_parallel = False
         ff, loss_kind = cli.build_model(name, cfg)
         cli.compile_model(ff, loss_kind)
-        from flexflow_tpu.search.validate import (diff_collectives,
+        from flexflow_tpu.search.validate import (COLLECTIVE_COVER,
+                                                  diff_collectives,
                                                   emitted_collectives,
                                                   priced_collectives,
                                                   train_step_hlo)
@@ -174,8 +189,32 @@ class TestCensusByteDrift:
         emitted = emitted_collectives(train_step_hlo(ff))
         # under-pricing only: phantom priced collectives ("emitted none")
         # are over-counts, the safe direction for the DP's ranking
-        return [p for p in diff_collectives(priced, emitted)
-                if "emitted none" not in p]
+        problems = [p for p in diff_collectives(priced, emitted)
+                    if "emitted none" not in p]
+        # edge-bytes-as-reference (same searched build, no extra search):
+        # the statically-inferred implicit edge + weight-movement bytes
+        # are a LOWER bound GSPMD will realize — the priced cover of each
+        # kind must at least reach it or the search ranked blind
+        from flexflow_tpu.analysis import (LintContext, edge_reshard_table,
+                                           weight_movement_edges)
+        ctx = LintContext(
+            nodes=ff.executor.nodes, mesh=ff.mesh, strategy=ff.strategy,
+            machine_spec=ff.machine_spec, config=ff.config,
+            final_ref=ff.executor.final_ref, ff=ff)
+        edge_bytes = {}
+        for e in list(edge_reshard_table(ctx)) + weight_movement_edges(ctx):
+            if e.explicit or e.kind == "slice" or e.bytes < (1 << 12):
+                continue
+            edge_bytes[e.kind] = edge_bytes.get(e.kind, 0.0) + e.bytes
+        for kind, eb in edge_bytes.items():
+            pb = sum(priced.get(k, 0.0)
+                     for k in COLLECTIVE_COVER.get(kind, {kind}))
+            if pb < eb:
+                problems.append(
+                    f"{kind}: edge-inferred {eb / 1e6:.2f} MB exceeds "
+                    f"priced cover {pb / 1e6:.2f} MB — native pricing "
+                    f"dropped below the static edge reference")
+        return problems
 
     @pytest.mark.analysis
     def test_searched_xdl_byte_drift_shrinks(self):
