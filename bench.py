@@ -286,14 +286,76 @@ def build_pipeline_transformer(on_cpu):
     ff = create_transformer(cfg, c)
     ff.compile(AdamOptimizer(alpha=1e-4, state_dtype=jnp.bfloat16),
                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [], mesh=mesh)
+    # block-level rematerialization (ISSUE 20): the searched pipeline
+    # 'remat' bit, engaged here so the family's hbm_peak_bytes ratchet
+    # records the remat footprint (measured 36% of the remat-less peak
+    # on the CPU config — the backward holds ONE block interior instead
+    # of every in-flight microbatch's). Step values stay in the last-ulp
+    # parity class of the remat-less step (XLA re-fuses the recomputed
+    # interior; tests/test_remat.py::test_pipeline_body_remat_parity_-
+    # at_pp2 bounds the drift); FFS_NO_REMAT opts out bit-identically,
+    # mirroring the search-side switch.
+    if not os.environ.get("FFS_NO_REMAT"):
+        ff.executor.body_remat = True
     rs = np.random.RandomState(0)
     x = rs.randn(cfg.batch_size, cfg.seq_length,
                  cfg.hidden_size).astype(np.float32)
     y = rs.randn(cfg.batch_size, cfg.seq_length, 1).astype(np.float32)
     out_cfg = dataclasses.asdict(cfg)
     out_cfg.update(pipe=pp, data=dp, microbatches=c.pipeline_microbatches,
-                   schedule=ff.executor.schedule)
+                   schedule=ff.executor.schedule,
+                   body_remat=ff.executor.body_remat)
     return ff, [x], y, out_cfg
+
+
+def build_longcontext_transformer(on_cpu):
+    """Long-context attention at seq 2048 (ISSUE 20), DEVICELESS: the
+    workload is never timed — its coordinates are the compile-determined
+    ratchets (hbm_peak_bytes, dispatch_count, collective_bytes) from
+    XLA's memory analysis, so it runs in seconds even though an
+    interpret-mode flash step would take minutes on CPU. It pins the
+    winning remat x kernel composition for long contexts, the lattice
+    point ``_k:flash_r``: flash never materializes the O(seq^2) score
+    interior, and remat then frees the boundary activations too —
+    remat of the EINSUM attention alone cannot cut the peak (the
+    recompute re-materializes the same interior at backward time;
+    tests/test_remat.py::test_long_context_attention_hbm_peak_at_seq_2k
+    asserts the same composition). FFS_NO_REMAT leaves the flash
+    lowering but drops the checkpoint, exactly like the executor's
+    opt-out."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import LossType
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    if on_cpu:
+        # the pallas flash kernel needs the interpreter off-TPU; on a
+        # real chip the compiled kernel runs as-is
+        os.environ.setdefault("FLEXFLOW_TPU_PALLAS", "interpret")
+    seq, hidden, layers = 2048, 32, 2
+    cfg = FFConfig(batch_size=2, seed=42)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((2, seq, hidden), name="x")
+    t = x
+    for i in range(layers):
+        t = ff.multihead_attention(t, t, t, hidden, 2, name=f"attn{i}")
+    ff.dense(t, hidden, name="fc")
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+               mesh=single_device_mesh_on_cpu(on_cpu))
+    attn = {f"attn{i}" for i in range(layers)}
+    for n in ff.executor.nodes:
+        if n.op.name in attn:
+            n.op.kernel_impl = "flash"
+    if not os.environ.get("FFS_NO_REMAT"):
+        ff.executor.remat_ops = attn
+    rs = np.random.RandomState(0)
+    xv = rs.randn(2, seq, hidden).astype(np.float32)
+    y = rs.randn(2, seq, hidden).astype(np.float32)
+    cfg_dict = dict(seq_length=seq, hidden_size=hidden, num_layers=layers,
+                    batch_size=2, kernel="flash",
+                    remat=not os.environ.get("FFS_NO_REMAT"))
+    return ff, [xv], y, cfg_dict
 
 
 def build_multislice_transformer(on_cpu):
@@ -348,6 +410,10 @@ WORKLOADS = [
     ("moe", build_moe, 30),
     ("pipeline_transformer", build_pipeline_transformer, 10),
     ("multislice_transformer", build_multislice_transformer, 10),
+    # iters=0 marks a DEVICELESS family: never timed, only the
+    # compile-determined ratchets engage (hbm_peak_bytes,
+    # dispatch_count, collective_bytes)
+    ("longcontext_transformer", build_longcontext_transformer, 0),
 ]
 
 
@@ -742,35 +808,44 @@ def main():
     memory_regressions = []
     exposed_regressions = []
     for name, build, iters in WORKLOADS:
-        iters = 5 if on_cpu else iters
+        compile_only = iters == 0
+        iters = iters if compile_only else (5 if on_cpu else iters)
         windows = 1 if on_cpu else 3
-        protocol = f"best{windows}x{iters}"
+        protocol = ("compile_only" if compile_only
+                    else f"best{windows}x{iters}")
         ff = None
         tracer = None
         try:
             ff, xs, y, cfg_dict = build(on_cpu)
             capture = None
-            if trace_dir:
-                from flexflow_tpu.obs import make_capture, make_tracer
-                tracer = make_tracer(trace_dir, run_name=name)
-                # windowed device capture over the post-compile warmup
-                # steps: exposed_comms_frac (the overlap direction's
-                # ratchet coordinate) without perturbing the measurement
-                if tracer.active:
-                    capture = make_capture(tracer, "1:3")
-            sps, step_samples = time_train(ff, xs, y, iters=iters,
-                                           windows=windows, tracer=tracer,
-                                           capture=capture)
             devrep = None
-            if capture is not None and capture.active:
-                try:
-                    devrep = capture.finalize(ff, tracer)
-                except Exception as e:
-                    print(f"[obs] {name}: devtrace attribution failed: "
-                          f"{e!r}", file=sys.stderr)
             summary = None
-            if tracer is not None and tracer.active:
-                summary = emit_obs_artifacts(name, ff, tracer)
+            if compile_only:
+                # deviceless family: no training loop — every recorded
+                # coordinate is a property of the compiled program
+                sps, step_samples = None, []
+            else:
+                if trace_dir:
+                    from flexflow_tpu.obs import make_capture, make_tracer
+                    tracer = make_tracer(trace_dir, run_name=name)
+                    # windowed device capture over the post-compile
+                    # warmup steps: exposed_comms_frac (the overlap
+                    # direction's ratchet coordinate) without perturbing
+                    # the measurement
+                    if tracer.active:
+                        capture = make_capture(tracer, "1:3")
+                sps, step_samples = time_train(ff, xs, y, iters=iters,
+                                               windows=windows,
+                                               tracer=tracer,
+                                               capture=capture)
+                if capture is not None and capture.active:
+                    try:
+                        devrep = capture.finalize(ff, tracer)
+                    except Exception as e:
+                        print(f"[obs] {name}: devtrace attribution "
+                              f"failed: {e!r}", file=sys.stderr)
+                if tracer is not None and tracer.active:
+                    summary = emit_obs_artifacts(name, ff, tracer)
             summary = step_summary_for(name, ff, summary)
             cbytes = census_bytes_of(summary)
             hbm_peak = hbm_peak_of(summary)
@@ -784,7 +859,18 @@ def main():
             workloads_out[name] = {"error": f"{type(e).__name__}: {e}"}
             continue
         key = f"{name}:{platform}"
-        vs, best, old_protocol = ratchet(hist, key, sps, cfg_dict, protocol)
+        if compile_only:
+            # no throughput to ratchet; record provenance so the entry
+            # still says what was compiled
+            vs = best = old_protocol = None
+            ent = hist.get(key)
+            if not isinstance(ent, dict):
+                ent = {}
+                hist[key] = ent
+            ent.update(protocol=protocol, config=cfg_dict)
+        else:
+            vs, best, old_protocol = ratchet(hist, key, sps, cfg_dict,
+                                             protocol)
         wl = {}
         if cbytes is not None:
             # the trace-regression gate (ROADMAP): a strategy change that
@@ -856,7 +942,8 @@ def main():
         # throughput. Informational (no ratchet: the simulator predicts
         # chip behavior, so a CPU round's ratio is a smoke value, and
         # chip rounds swing with tunnel weather).
-        sim_ratio = sim_accuracy_of(name, ff, p50, sps, cfg_dict)
+        sim_ratio = (None if compile_only
+                     else sim_accuracy_of(name, ff, p50, sps, cfg_dict))
         if sim_ratio is not None:
             wl["sim_accuracy_ratio"] = sim_ratio
         # measured exposed-comms fraction from the warmup-window device
@@ -897,6 +984,8 @@ def main():
                 "best_recorded": round(best, 3),
             })
             result.update(wl)
+        elif compile_only:
+            workloads_out[name] = dict({"compile_only": True}, **wl)
         else:
             workloads_out[name] = dict(
                 {"value": round(sps, 3),

@@ -140,6 +140,16 @@ class FFConfig:
     # results bit-identically and the executor keeps its availability-
     # based defaults.
     kernel_search: str = "auto"
+    # rematerialization search (ISSUE 20): 'auto' lets the native DP
+    # enumerate "_r" choice twins — each checkpoints the op's boundary
+    # activations and recomputes the interior in backward, priced as
+    # +recompute-forward in the backward term vs -interior act_memory in
+    # the frontier DP's memory terms (so '_r' only wins under HBM
+    # pressure); pipe meshes instead sweep a block-level 'remat' bit on
+    # the pipeline candidate. 'off' (or FFS_NO_REMAT=1) removes the
+    # dimension: searches reproduce pre-remat results bit-identically and
+    # the executors never insert jax.checkpoint.
+    remat_search: str = "auto"
     # fflint static verification at compile time (flexflow_tpu/analysis):
     # "off" skips it, "warn" prints the report, "error" additionally
     # raises when any ERROR-severity diagnostic fires (illegal sharding
@@ -344,6 +354,12 @@ class FFConfig:
                     raise ValueError(
                         f"--kernel-search expects auto|off, got {v!r}")
                 self.kernel_search = v
+            elif a == "--remat-search":
+                v = take().lower()
+                if v not in ("auto", "off"):
+                    raise ValueError(
+                        f"--remat-search expects auto|off, got {v!r}")
+                self.remat_search = v
             elif a == "--weight-update-sharding":
                 v = take().lower()
                 if v not in ("auto", "on", "off"):

@@ -72,6 +72,7 @@ class GraphExecutor:
         overlap_grad_sync: bool = False,
         overlap_bucket_bytes: int = 4 << 20,
         kernel_choices: Optional[Dict[str, str]] = None,
+        remat_ops: Optional[set] = None,
     ):
         self.nodes = nodes
         self.by_guid = {n.guid: n for n in nodes}
@@ -134,6 +135,14 @@ class GraphExecutor:
         # op keeps its availability-based default, bit-identical to
         # pre-kernel-search execution.
         self.kernel_choices = dict(kernel_choices) if kernel_choices else None
+        # per-op searched rematerialization (ISSUE 20): names of ops whose
+        # '_r' choice won — their forward runs under jax.checkpoint, so
+        # backward keeps only the op's boundary (inputs + params) and
+        # recomputes the interior. The native gate (ffs_strategy.hpp
+        # remat_gate) only spawns '_r' twins for stateless, collective-free
+        # ops, so the plain-forward branch below is the only wrap point.
+        # None/empty = no remat, bit-identical to pre-remat execution.
+        self.remat_ops = set(remat_ops) if remat_ops else None
         self.fused_update_ops = {
             n for n, impl in (self.kernel_choices or {}).items()
             if impl == "fused"}
@@ -508,6 +517,15 @@ class GraphExecutor:
                     op._new_state = None
                 elif op.name in state:
                     new_state[op.name] = state[op.name]
+            elif ctx.training and self.remat_ops \
+                    and op.name in self.remat_ops:
+                # searched '_r' choice: checkpoint the op's boundary and
+                # recompute its interior in backward (gate-legal ops are
+                # stateless with no aux side channel)
+                outs = jax.checkpoint(
+                    lambda p_, a_, f_=op.forward: tuple(f_(p_, list(a_),
+                                                           ctx))
+                )(params.get(op.name, {}), tuple(args))
             else:
                 outs = op.forward(params.get(op.name, {}), args, ctx)
             if getattr(op, "_aux_loss", None) is not None:

@@ -864,6 +864,20 @@ class FFModel:
                 if getattr(n.op, "_kernel_fallback", None) is not None:
                     n.op._kernel_fallback = None
         self.kernel_choices = kernel_choices
+        # rematerialization (ISSUE 20): on flat meshes the search prices
+        # per-op '_r' twins — ops whose twin won run under jax.checkpoint
+        # (executor remat_ops); pipe meshes never enumerate '_r' twins and
+        # instead carry a block-level 'remat' bit in the searched pipeline
+        # object (body_remat below). The off switch (--remat-search off /
+        # FFS_NO_REMAT) forces both off — bit-identical to pre-remat
+        # execution.
+        from flexflow_tpu.search.unity import executed_remat_ops
+        remat_on = (str(getattr(cfg, "remat_search", "auto")).lower() != "off"
+                    and not _os.environ.get("FFS_NO_REMAT"))
+        remat_ops: Optional[set] = None
+        if remat_on and axes_now.get("pipe", 1) == 1:
+            remat_ops = executed_remat_ops(nodes, self.strategy) or None
+        self.remat_ops = remat_ops
         exec_kwargs = dict(compute_dtype=compute_dtype, data_axes=data_axes,
                            final_is_softmax=self._final_is_softmax,
                            fold_conv_bn=cfg.fold_conv_bn,
@@ -873,7 +887,8 @@ class FFModel:
                            # MB (1e6), matching the native bucket sweep's
                            # wire-byte unit (ffs_strategy.hpp kOvlBucketMB)
                            overlap_bucket_bytes=int(bucket_mb * 1e6),
-                           kernel_choices=kernel_choices)
+                           kernel_choices=kernel_choices,
+                           remat_ops=remat_ops)
         # conv-family execution layout (flexflow_tpu/layout.py): NCHW stays
         # the API/PCG boundary, but on TPU the conv family computes
         # channels-last with boundary transposes hoisted to chain edges.
@@ -916,6 +931,7 @@ class FFModel:
                 microbatches=microbatches,
                 schedule=schedule,
                 shard_queue=getattr(cfg, "pipeline_shard_queue", True),
+                body_remat=bool(remat_on and pinfo.get("remat")),
                 **exec_kwargs)
         else:
             self.layout_info = propagate_layouts(nodes, **self._layout_args)

@@ -134,7 +134,17 @@ def collective_census_by_fabric(hlo_text: str, chips_per_slice: int,
     slice-major order ``model.compile`` lays the ('slice', ...) mesh
     out in). A collective with no / unparseable replica_groups engages
     every participant — on a multi-slice mesh that spans, so it counts
-    as DCN (conservative: the methodology BENCH_NOTES documents)."""
+    as DCN (conservative: the methodology BENCH_NOTES documents).
+
+    Byte attribution is DECOMPOSED (ISSUE 20 r16): XLA lowers a
+    spanning all-reduce hierarchically — intra-slice reduce-scatter,
+    inter-slice exchange on the 1/d-sized shard each chip then holds
+    (d = the group's largest single-slice membership), intra-slice
+    all-gather — so only ``bytes/d`` of the payload crosses DCN; the
+    remaining ``bytes*(1-1/d)`` moves on ICI and is charged there. A
+    group with one chip per slice (d = 1) has no intra-slice stage and
+    charges its full payload to DCN. Counts keep the old whole-fabric
+    attribution: a spanning collective counts once, under "dcn"."""
     out = {"ici": dict(count=0, bytes=0.0), "dcn": dict(count=0, bytes=0.0)}
     cps = max(1, int(chips_per_slice))
     for line in hlo_text.splitlines():
@@ -150,14 +160,28 @@ def collective_census_by_fabric(hlo_text: str, chips_per_slice: int,
             continue
         rg = _RG_RE.search(rhs)
         groups = parse_replica_groups(rg.group(1)) if rg else None
+        intra = 0  # largest single-slice membership over spanning groups
         if groups:
-            spans = any(len({d // cps for d in g}) > 1
-                        for g in groups if g)
+            spans = False
+            for g in groups:
+                if not g or len({d // cps for d in g}) <= 1:
+                    continue
+                spans = True
+                per_slice: Dict[int, int] = {}
+                for d in g:
+                    per_slice[d // cps] = per_slice.get(d // cps, 0) + 1
+                intra = max(intra, max(per_slice.values()))
         else:
             spans = True  # flat/implicit group: all participants
-        e = out["dcn" if spans else "ici"]
-        e["count"] += 1
-        e["bytes"] += b
+            intra = cps
+        if spans:
+            out["dcn"]["count"] += 1
+            dcn_b = b / max(1, intra)
+            out["dcn"]["bytes"] += dcn_b
+            out["ici"]["bytes"] += b - dcn_b  # intra-slice stages
+        else:
+            out["ici"]["count"] += 1
+            out["ici"]["bytes"] += b
     return out
 
 

@@ -170,19 +170,19 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
                 queue_feed = jax.lax.dynamic_index_in_dim(
                     xs, feed, 0, keepdims=False)
             if use_circ:
-                # rounds >= 1 re-enter from the recirculation buffer.
-                # qsharded: the buffer is a W = M-S+1 slot ring (value u
-                # lives from its bank tick u+S-1 to its consume tick
+                # rounds >= 1 re-enter from the recirculation buffer —
+                # a W = M-S+1 slot ring in BOTH queue lowerings (a value
+                # u lives from its bank tick u+S-1 to its consume tick
                 # u+M, so at most W slots are ever live); the value fed
                 # at global step u0 is microbatch u0-M of the previous
-                # round, parked in slot (u0-M) % W. Replicated queue:
-                # M slots, doubling as the round-0 feed.
+                # round, parked in slot (u0-M) % W. Round 0 feeds from
+                # the queue directly (ISSUE 20 satellite: the replicated
+                # fallback no longer keeps a full M-slot ring).
                 u0 = jnp.clip(t, 0, rounds * M - 1)
-                cslot = ((u0 - M) % (M - S + 1)) if qsharded else (u0 % M)
+                cslot = (u0 - M) % (M - S + 1)
                 circ_feed = jax.lax.dynamic_index_in_dim(
                     circ, cslot, 0, keepdims=False)
-                feed_val = jnp.where(t < M, queue_feed, circ_feed) \
-                    if qsharded else circ_feed
+                feed_val = jnp.where(t < M, queue_feed, circ_feed)
             else:
                 feed_val = queue_feed
             cur = jnp.where(idx == 0, feed_val, state)
@@ -228,20 +228,18 @@ def pipeline_spmd(stage_fn, stacked_params, x, mesh, *, num_microbatches,
                 # the ring write at tick t lands on the slot whose value
                 # was consumed THIS tick ((t-S+1) - (t-M) = W) — safe
                 # because circ_feed above read the pre-update buffer
-                s_arr = (u_arr % (M - S + 1)) if qsharded else (u_arr % M)
+                s_arr = u_arr % (M - S + 1)
                 prevc = jax.lax.dynamic_index_in_dim(circ, s_arr, 0,
                                                      keepdims=False)
                 circ = jax.lax.dynamic_update_index_in_dim(
                     circ, jnp.where(ok, state, prevc), s_arr, 0)
             return state, outs, circ, in_stream, out_stream
 
-        if use_circ and qsharded:
-            # windowed to the M-S+1 in-flight slots (vs the replicated
-            # path's full M): the HBM win the simulator's queue-memory
-            # term prices with the same (M-pp+1)/M factor
+        if use_circ:
+            # windowed to the M-S+1 in-flight slots in BOTH queue
+            # lowerings: the HBM win the simulator's queue-memory term
+            # prices with the same (M-pp+1)/M factor
             circ0 = jnp.zeros((M - S + 1,) + xs.shape[1:], xs.dtype)
-        elif use_circ:
-            circ0 = xs  # replicated queue doubles as the round-0 feed
         else:
             circ0 = jnp.zeros((1,) + xs.shape[1:], xs.dtype)  # unused
         if qsharded:

@@ -50,8 +50,16 @@ BODY_KEY = "__pipe_body__"
 class PipelineGraphExecutor(GraphExecutor):
     def __init__(self, *args, pipe_blocks=None, microbatches: int = 0,
                  pipe_axis: str = "pipe", schedule: str = "auto",
-                 shard_queue: bool = True, **kwargs):
+                 shard_queue: bool = True, body_remat: bool = False,
+                 **kwargs):
         super().__init__(*args, **kwargs)
+        # block-level rematerialization (ISSUE 20): the searched pipeline
+        # 'remat' bit. Each block body runs under jax.checkpoint, so a
+        # stage keeps only block BOUNDARY activations per in-flight
+        # microbatch and recomputes block interiors in backward — the HBM
+        # term ffs_sim.hpp prices as k*block_out/dp + one transient
+        # interior. False = bit-identical to pre-remat execution.
+        self.body_remat = bool(body_remat)
         if pipe_blocks is None:
             raise ValueError("PipelineGraphExecutor needs detected blocks")
         self.pb = pipe_blocks
@@ -304,11 +312,16 @@ class PipelineGraphExecutor(GraphExecutor):
 
     def _stage_fn(self, training: bool):
         ctx = OpContext(training=training, compute_dtype=self.compute_dtype)
+        run = lambda pb, x: self._run_block_template(pb, x, ctx)  # noqa: E731
+        if training and self.body_remat:
+            # per-BLOCK checkpoint (not per-stage): backward peak holds one
+            # block interior regardless of blocks_per_stage
+            run = jax.checkpoint(run)
         if self.schedule == "circular" and self.blocks_per_stage > 1:
             # circular: pipeline_spmd indexes the round's block slice and
             # hands ONE block's params per tick
             def stage_fn(p_block, x):
-                return self._run_block_template(p_block, x, ctx)
+                return run(p_block, x)
 
             return stage_fn
         k = self.blocks_per_stage
@@ -316,7 +329,7 @@ class PipelineGraphExecutor(GraphExecutor):
         def stage_fn(p_local, x):
             for b in range(k):
                 pb = jax.tree.map(lambda w: w[b], p_local)
-                x = self._run_block_template(pb, x, ctx)
+                x = run(pb, x)
             return x
 
         return stage_fn

@@ -89,10 +89,33 @@ def _node_attrs(op) -> Dict[str, Any]:
 
 def kernel_choice_of(choice: Optional[str]) -> Optional[str]:
     """Kernel impl a choice name selects (the ``_k:<impl>`` suffix of
-    the suffix lattice, ISSUE 15), or None for the default lowering."""
+    the suffix lattice, ISSUE 15), or None for the default lowering.
+    The trailing ``_r`` remat suffix (canonical order
+    ``base[_wus][_ovl][_k:impl][_r]``) is not part of the impl name."""
     if not choice or "_k:" not in choice:
         return None
-    return choice.split("_k:", 1)[1]
+    impl = choice.split("_k:", 1)[1]
+    if impl.endswith("_r"):
+        impl = impl[:-2]
+    return impl or None
+
+
+def remat_choice_of(choice: Optional[str]) -> bool:
+    """Whether a choice name selects the rematerialized ("_r") twin —
+    the executor then routes the op through jax.checkpoint (ISSUE 20)."""
+    return bool(choice) and choice.endswith("_r")
+
+
+def executed_remat_ops(nodes, strategy) -> set:
+    """{op name} whose searched choice carries the ``_r`` remat suffix —
+    the per-op checkpoint policy the executor applies (the
+    ``wus_ops``/``kernel_choices`` per-op pattern)."""
+    out = set()
+    for node in nodes:
+        st = (strategy or {}).get(node.op.guid)
+        if remat_choice_of(getattr(st, "choice", None)):
+            out.add(node.op.name)
+    return out
 
 
 def executed_kernel_choices(nodes, strategy, mesh_axes,
@@ -171,11 +194,19 @@ def machine_to_json(spec, num_devices: int,
     prices covered op classes with; None (the default and the
     FFS_NO_LEARNED_COSTS state) keeps pure analytic pricing —
     bit-identical to pre-costmodel behavior."""
-    # arbitrary inter-slice fabrics reduce to the ring's bottleneck
-    # (bandwidth, routed latency) — MachineSpec.effective_dcn
-    dcn_bw, dcn_latency = (spec.effective_dcn()
-                           if hasattr(spec, "effective_dcn")
-                           else (spec.dcn_bw, spec.dcn_latency))
+    # arbitrary inter-slice fabrics: ship the RAW per-pair link matrix —
+    # the native pricer applies the bottleneck-link rule per collective
+    # SPAN (MachineModel::dcn_ring), so a 2-slice collective on a fabric
+    # whose far link is slow prices at the near pair's bandwidth instead
+    # of the global collapse. The scalar (dcn_bw, dcn_latency) stays the
+    # uniform fallback; without links, effective_dcn() returns it as-is.
+    dcn_links = list(getattr(spec, "dcn_links", None) or [])
+    if dcn_links:
+        dcn_bw, dcn_latency = spec.dcn_bw, spec.dcn_latency
+    else:
+        dcn_bw, dcn_latency = (spec.effective_dcn()
+                               if hasattr(spec, "effective_dcn")
+                               else (spec.dcn_bw, spec.dcn_latency))
     out = dict(
         num_devices=num_devices,
         flops=spec.flops,
@@ -204,6 +235,9 @@ def machine_to_json(spec, num_devices: int,
         # per-axis ring pricing (ffs_machine.hpp assign_torus)
         torus=[int(t) for t in getattr(spec, "torus", None) or []],
     )
+    if dcn_links:
+        out["dcn_links"] = [[int(a), int(b), float(bw)]
+                            for a, b, bw in dcn_links]
     if learned:
         out["learned"] = learned
     return out
@@ -405,6 +439,16 @@ def graph_optimize(nodes, machine_spec, config, num_devices: int,
             kernel_search=("off" if (
                 str(getattr(config, "kernel_search", "auto")).lower()
                 == "off" or os.environ.get("FFS_NO_KERNEL_SEARCH"))
+                else "auto"),
+            # rematerialization as a searched dimension (ISSUE 20):
+            # "auto" spawns the "_r" remat choice twins (checkpoint the
+            # op, recompute its interior in backward) and the pipeline
+            # block-body remat dimension; "off" or FFS_NO_REMAT removes
+            # the dimension — searches then reproduce pre-remat-search
+            # results bit-identically
+            remat_search=("off" if (
+                str(getattr(config, "remat_search", "auto")).lower()
+                == "off" or os.environ.get("FFS_NO_REMAT"))
                 else "auto"),
             # search provenance: per-mesh candidates + rejection reasons,
             # frontier-DP evolution, per-op candidate cost table

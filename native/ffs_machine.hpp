@@ -93,6 +93,16 @@ struct MachineModel {
   // TPU hardware actually has. Empty = flat (every axis prices alike).
   std::vector<int64_t> torus;
 
+  // Explicit non-uniform inter-slice fabric: dcn_adj[a][b] = best direct
+  // link bandwidth between slices a and b (0 = no direct link), built
+  // from the spec's `dcn_links` triples. Empty = uniform fabric at
+  // dcn_bw. With a fabric present, every DCN pricer resolves the
+  // (bandwidth, latency) of the ring RESTRICTED to the slices a
+  // collective actually spans (dcn_ring below) instead of the global
+  // collapse MachineSpec.effective_dcn used to pre-bake — the
+  // bottleneck-link rule, per span (ISSUE 20 satellite).
+  std::vector<std::vector<double>> dcn_adj;
+
   // Learned per-op-class compute pricing (empty = analytic only; the
   // Python side omits the table under FFS_NO_LEARNED_COSTS or when no
   // trained COSTMODEL.json exists, so absence == pre-costmodel
@@ -230,6 +240,23 @@ struct MachineModel {
     const Json& tj = j.get("torus");
     if (!tj.is_null())
       for (const Json& t : tj.items()) m.torus.push_back(t.as_int(1));
+    const Json& dl = j.get("dcn_links");
+    if (!dl.is_null() && m.num_slices > 1) {
+      const int S = m.num_slices;
+      std::vector<std::vector<double>> adj(S, std::vector<double>(S, 0.0));
+      bool any = false;
+      for (const Json& e : dl.items()) {
+        int a = static_cast<int>(e[0].as_int(-1));
+        int b = static_cast<int>(e[1].as_int(-1));
+        double bw = e[2].as_double(0.0);
+        if (a < 0 || b < 0 || a >= S || b >= S || a == b || bw <= 0)
+          continue;
+        adj[a][b] = std::max(adj[a][b], bw);
+        adj[b][a] = std::max(adj[b][a], bw);
+        any = true;
+      }
+      if (any) m.dcn_adj = std::move(adj);
+    }
     const Json& lj = j.get("learned");
     if (!lj.is_null()) {
       m.learned_hull_margin =
@@ -296,12 +323,78 @@ struct MachineModel {
            bytes * (k - 1) / k / k / (ring_bw() * axbw(axis));
   }
 
+  // Hop-shortest, then widest-bottleneck route a->b over the explicit
+  // inter-slice link graph — the native twin of
+  // MachineSpec.effective_dcn's route() (Bellman-Ford relaxation).
+  // Unreachable pairs fall back to the uniform dcn_bw with a 2-hop
+  // penalty (the fabric must be connected through a spine).
+  void dcn_route(int a, int b, int* hops, double* bw) const {
+    const int S = num_slices;
+    std::vector<int> h(S, -1);
+    std::vector<double> w(S, 0.0);
+    h[a] = 0;
+    w[a] = 1e300;
+    for (int it = 0; it < S; ++it) {
+      bool changed = false;
+      for (int u = 0; u < S; ++u) {
+        if (h[u] < 0) continue;
+        for (int v = 0; v < S; ++v) {
+          double link = dcn_adj[u][v];
+          if (link <= 0) continue;
+          int ch = h[u] + 1;
+          double cw = std::min(w[u], link);
+          if (h[v] < 0 || ch < h[v] || (ch == h[v] && cw > w[v])) {
+            h[v] = ch;
+            w[v] = cw;
+            changed = true;
+          }
+        }
+      }
+      if (!changed) break;
+    }
+    if (h[b] < 0 || w[b] >= 1e300) {
+      *hops = 2;
+      *bw = dcn_bw;
+    } else {
+      *hops = h[b];
+      *bw = w[b];
+    }
+  }
+
+  // (bandwidth, latency) of the cross-slice DCN ring restricted to the
+  // `slices` consecutive slices a collective actually spans: the ring is
+  // paced by its slowest routed pair and latency scales with the longest
+  // routed path. Uniform fabric (no dcn_links) keeps (dcn_bw,
+  // dcn_latency) — bit-identical to the pre-fabric model.
+  void dcn_ring(int slices, double* bw, double* lat) const {
+    *bw = dcn_bw;
+    *lat = dcn_latency;
+    if (dcn_adj.empty() || num_slices <= 1 || slices <= 1) return;
+    slices = std::min(slices, num_slices);
+    double worst_bw = 1e300;
+    int worst_hops = 1;
+    for (int i = 0; i < slices; ++i) {
+      int a = i, b = (i + 1) % slices;
+      if (a == b) continue;
+      int hops;
+      double bbw;
+      dcn_route(a, b, &hops, &bbw);
+      worst_bw = std::min(worst_bw, bbw);
+      worst_hops = std::max(worst_hops, hops);
+    }
+    if (worst_bw >= 1e300) worst_bw = dcn_bw;
+    *bw = worst_bw;
+    *lat = dcn_latency * worst_hops;
+  }
+
   // Cross-slice (DCN) all-reduce of `bytes` across num_slices.
   double dcn_allreduce_time(double bytes) const {
     bytes *= comm_bytes_factor;
     if (num_slices <= 1 || bytes <= 0) return 0.0;
-    return dcn_latency * (num_slices - 1) +
-           2.0 * (num_slices - 1) / num_slices * bytes / dcn_bw;
+    double bw, lat;
+    dcn_ring(num_slices, &bw, &lat);
+    return lat * (num_slices - 1) +
+           2.0 * (num_slices - 1) / num_slices * bytes / bw;
   }
 
   int chips_per_slice() const {
@@ -323,8 +416,9 @@ struct MachineModel {
     int k_inner = std::max(1, k / slices);
     double t = allreduce_time(bytes, k_inner, axis);
     double shard = bytes * comm_bytes_factor / k_inner;
-    t += dcn_latency * (slices - 1) +
-         2.0 * (slices - 1) / slices * shard / dcn_bw;
+    double bw, lat;
+    dcn_ring(slices, &bw, &lat);
+    t += lat * (slices - 1) + 2.0 * (slices - 1) / slices * shard / bw;
     return t;
   }
 
@@ -339,8 +433,9 @@ struct MachineModel {
     double t = reducescatter_time(bytes, k_inner, axis);
     if (slices > 1) {
       double shard = bytes * comm_bytes_factor / k_inner;
-      t += dcn_latency * (slices - 1) +
-           2.0 * (slices - 1) / slices * shard / dcn_bw;
+      double bw, lat;
+      dcn_ring(slices, &bw, &lat);
+      t += lat * (slices - 1) + 2.0 * (slices - 1) / slices * shard / bw;
     }
     return t;
   }
@@ -354,8 +449,9 @@ struct MachineModel {
     double t = allgather_time(bytes, k_inner, axis);
     if (slices > 1) {
       double shard = bytes * comm_bytes_factor / k_inner;
-      t += dcn_latency * (slices - 1) +
-           (double)(slices - 1) / slices * shard / dcn_bw;
+      double bw, lat;
+      dcn_ring(slices, &bw, &lat);
+      t += lat * (slices - 1) + (double)(slices - 1) / slices * shard / bw;
     }
     return t;
   }

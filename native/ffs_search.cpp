@@ -73,6 +73,10 @@ struct SearchConfig {
   bool enable_kernels = true;       // kernel-implementation "_k:<impl>"
                                     // choice twins (--kernel-search !=
                                     // off / FFS_NO_KERNEL_SEARCH unset)
+  bool enable_remat = true;         // rematerialization "_r" choice twins
+                                    // + the pipeline body_remat dimension
+                                    // (--remat-search != off /
+                                    // FFS_NO_REMAT unset)
   bool emit_trace = false;          // structured search-trace emission
                                     // (search provenance; explain.py /
                                     // obs .searchtrace.json artifact)
@@ -114,6 +118,10 @@ struct SearchConfig {
     // "off" removes the dimension entirely (FFS_NO_KERNEL_SEARCH's
     // bit-identical pre-kernel-search escape hatch)
     c.enable_kernels = j.get("kernel_search").as_string() != "off";
+    // "auto" spawns the "_r" remat twins + the pipeline body-remat
+    // dimension; "off" removes the dimension entirely (FFS_NO_REMAT's
+    // bit-identical pre-remat-search escape hatch)
+    c.enable_remat = j.get("remat_search").as_string() != "off";
     c.emit_trace = j.get("emit_search_trace").as_bool(false);
     for (const Json& r : j.get("rules").items()) {
       std::vector<std::string> names;
@@ -159,7 +167,15 @@ std::vector<std::vector<Choice>> all_choices(const Graph& g, const MeshShape& me
                                 // lowering it cannot deliver would
                                 // misrank strategies (the _ovl lesson).
                                 cfg.enable_kernels && mesh.pp == 1,
-                                cfg.training);
+                                cfg.training,
+                                // "_r" remat twins. Not on pipe meshes:
+                                // body ops run through the stacked block
+                                // template, which has no per-op
+                                // checkpoint plumbing — pipe meshes get
+                                // the block-level body_remat dimension
+                                // (simulate_pipeline) instead.
+                                cfg.enable_remat && cfg.training &&
+                                    mesh.pp == 1);
     auto it = cfg.allowed.find(n.type);
     if (it != cfg.allowed.end()) {
       std::vector<Choice> kept;
@@ -607,6 +623,7 @@ struct GraphEval {
   int64_t states = 0;
   int pipe_microbatches = 0;      // > 0 when mesh.pp > 1
   std::string pipe_schedule;      // "gpipe"|"circular" when mesh.pp > 1
+  bool pipe_remat = false;        // block-body rematerialization chosen
 };
 
 // Candidate microbatch counts for a pipe mesh: the explicit flag, or the
@@ -684,19 +701,27 @@ GraphEval eval_graph(const Graph& g, const MachineModel& m,
         for (bool circ : scheds) {
           // the circular runtime needs M >= stages (recirculation)
           if (circ && kblocks > 1 && M < mesh.pp) continue;
-          SimResult sr = simulate_pipeline(
-              g, mt, mesh, cs0, pipe, cfg.training, cfg.opt_state_factor,
-              &measured, M, circ, cfg.pipeline_shard_queue);
-          if (threshold > 0 && sr.memory > threshold) continue;
-          if (sr.iteration_time < ev.time) {
-            ev.time = sr.iteration_time;
-            ev.mesh = mesh;
-            ev.assign = dp.assign;
-            ev.choices = choices;
-            ev.sim = sr;
-            ev.ok = true;
-            ev.pipe_microbatches = M;
-            ev.pipe_schedule = circ ? "circular" : "gpipe";
+          // block-body rematerialization as a third pipe dimension:
+          // remat strictly adds recompute time, so it wins only when
+          // the non-remat twin misses the memory threshold
+          for (int remat = 0;
+               remat <= (cfg.enable_remat && cfg.training ? 1 : 0);
+               ++remat) {
+            SimResult sr = simulate_pipeline(
+                g, mt, mesh, cs0, pipe, cfg.training, cfg.opt_state_factor,
+                &measured, M, circ, cfg.pipeline_shard_queue, remat != 0);
+            if (threshold > 0 && sr.memory > threshold) continue;
+            if (sr.iteration_time < ev.time) {
+              ev.time = sr.iteration_time;
+              ev.mesh = mesh;
+              ev.assign = dp.assign;
+              ev.choices = choices;
+              ev.sim = sr;
+              ev.ok = true;
+              ev.pipe_microbatches = M;
+              ev.pipe_schedule = circ ? "circular" : "gpipe";
+              ev.pipe_remat = remat != 0;
+            }
           }
         }
       }
@@ -910,6 +935,17 @@ Json choice_trace_json(const Node& n, const Choice& c, const MeshShape& mesh,
   mem.set("opt_state_bytes", Json(std::max(0.0, pmem - param_b)));
   mem.set("act_bytes", Json(node_act_bytes(n, c, mesh)));
   cj.set("memory", mem);
+  if (c.remat) {
+    // the "_r" tradeoff row explain.py renders: activation bytes the
+    // checkpoint frees (the non-remat twin's residual) vs the forward
+    // seconds backward re-spends recomputing the interior
+    Choice base_c = c;
+    base_c.remat = false;
+    Json rj = Json::object();
+    rj.set("freed_act_bytes", Json(node_act_bytes(n, base_c, mesh)));
+    rj.set("recompute_s", Json(base.fwd));
+    cj.set("remat", rj);
+  }
   cj.set("collectives",
          choice_collectives_json(c, cfg.training, mesh, m));
   return cj;
@@ -962,6 +998,23 @@ Json per_op_trace(const Graph& g,
       if (n.type == "MULTIHEAD_ATTENTION") note("flash");
       if (n.type == "CONV2D" && cfg.training) note("conv_bn_fused");
       if (!krej.items().empty()) oj.set("kernel_rejections", krej);
+    }
+    // "_r" twins the remat gate rejected for this op's CHOSEN lowering,
+    // with the gate's named reason (e.g. interior_not_larger_than_boundary
+    // on an elementwise op, dropout_interior on a dropout attention) —
+    // the remat analog of kernel_rejections (ISSUE 20)
+    if (cfg.enable_remat && cfg.training && mesh.pp == 1) {
+      const Choice& chosen_c = choices[i][assign[i]];
+      if (!chosen_c.remat) {
+        std::string why = remat_gate(n, chosen_c, cfg.training);
+        if (!why.empty()) {
+          Json rrej = Json::array();
+          Json r = Json::object();
+          r.set("reason", Json(why));
+          rrej.push_back(std::move(r));
+          oj.set("remat_rejections", rrej);
+        }
+      }
     }
     Json cands = Json::array();
     for (size_t ci = 0; ci < choices[i].size(); ++ci)
@@ -1057,21 +1110,28 @@ Json build_search_trace(const Graph& g, const MachineModel& m,
         if (b > 0 && (b % ((int64_t)M * std::max(1, mesh.dp)))) continue;
         for (bool circ : scheds) {
           if (circ && kblocks > 1 && M < mesh.pp) continue;
-          SimResult sr = simulate_pipeline(
-              g, mt, mesh, cs, pipe, cfg.training, cfg.opt_state_factor,
-              &measured, M, circ, cfg.pipeline_shard_queue);
-          any = true;
-          Json pc = Json::object();
-          pc.set("microbatches", Json((int64_t)M));
-          pc.set("schedule", Json(std::string(circ ? "circular" : "gpipe")));
-          pc.set("time_s", Json(sr.iteration_time));
-          pc.set("memory_bytes", Json(sr.memory));
-          bool fits = !(threshold > 0 && sr.memory > threshold);
-          pc.set("fits_memory", Json(fits));
-          cand.push_back(std::move(pc));
-          if (fits) {
-            any_fit = true;
-            best_t = std::min(best_t, sr.iteration_time);
+          for (int remat = 0;
+               remat <= (cfg.enable_remat && cfg.training ? 1 : 0);
+               ++remat) {
+            SimResult sr = simulate_pipeline(
+                g, mt, mesh, cs, pipe, cfg.training, cfg.opt_state_factor,
+                &measured, M, circ, cfg.pipeline_shard_queue, remat != 0);
+            any = true;
+            Json pc = Json::object();
+            pc.set("microbatches", Json((int64_t)M));
+            pc.set("schedule",
+                   Json(std::string(circ ? "circular" : "gpipe")));
+            if (cfg.enable_remat && cfg.training)
+              pc.set("remat", Json(remat != 0));
+            pc.set("time_s", Json(sr.iteration_time));
+            pc.set("memory_bytes", Json(sr.memory));
+            bool fits = !(threshold > 0 && sr.memory > threshold);
+            pc.set("fits_memory", Json(fits));
+            cand.push_back(std::move(pc));
+            if (fits) {
+              any_fit = true;
+              best_t = std::min(best_t, sr.iteration_time);
+            }
           }
         }
       }
@@ -1139,6 +1199,7 @@ Json build_search_trace(const Graph& g, const MachineModel& m,
       Json pj = Json::object();
       pj.set("microbatches", Json((int64_t)best.pipe_microbatches));
       pj.set("schedule", Json(best.pipe_schedule));
+      pj.set("remat", Json(best.pipe_remat));
       tr.set("winner_pipeline", pj);
     }
     tr.set("ops", per_op_trace(g, best.choices, best.assign, best.mesh, mt,
@@ -1324,6 +1385,9 @@ Json optimize(const Json& req) {
     pj.set("schedule", Json(best.pipe_schedule.empty()
                                 ? std::string("gpipe")
                                 : best.pipe_schedule));
+    // block-body rematerialization: the executor wraps the stage's block
+    // template in jax.checkpoint when true (ISSUE 20)
+    pj.set("remat", Json(best.pipe_remat));
     out.set("pipeline", pj);
   }
   Json ops = Json::object();
@@ -1459,26 +1523,36 @@ Json simulate_only(const Json& req) {
     };
     const Choice* pick = find(want);
     if (pick == nullptr) {
-      // suffix fallback both ways for the "_wus"/"_ovl"/"_k:" twins: a
-      // heuristic replay may ask for a twin an op doesn't spawn (no
-      // gradsync), and a stale strategy file may lack the suffixes an
-      // enabled run expects. Canonical order is base[+_wus][+_ovl]
-      // [+_k:impl]. Candidates walk the suffix lattice nearest the
-      // REQUESTED suffixes first: keep the "_k:" kernel suffix where a
-      // twin carries it, then drop it (a kernel-search-off replay of a
-      // kernel-searched strategy prices the default lowering), toggling
-      // "_ovl" (a pure latency-hiding pricing delta) before "_wus"
-      // (which also moves optimizer-state memory and the update triad)
-      // — so e.g. a plain "dp_ovl" request never silently picks up WUS
-      // pricing while "dp" is available.
+      // suffix fallback both ways for the "_wus"/"_ovl"/"_k:"/"_r"
+      // twins: a heuristic replay may ask for a twin an op doesn't
+      // spawn (no gradsync), and a stale strategy file may lack the
+      // suffixes an enabled run expects. Canonical order is
+      // base[+_wus][+_ovl][+_k:impl][+_r]. Candidates walk the suffix
+      // lattice nearest the REQUESTED suffixes first: keep the "_r"
+      // remat suffix and the "_k:" kernel suffix where twins carry
+      // them, then drop them (a remat/kernel-search-off replay prices
+      // the default lowering), toggling "_ovl" (a pure latency-hiding
+      // pricing delta) before "_wus" (which also moves optimizer-state
+      // memory and the update triad) — so e.g. a plain "dp_ovl" request
+      // never silently picks up WUS pricing while "dp" is available.
       auto strip = [](std::string s, const char* sfx) {
         size_t n = strlen(sfx);
         if (s.size() > n && s.compare(s.size() - n, n, sfx) == 0)
           s.erase(s.size() - n);
         return s;
       };
-      std::string ksuffix;
       std::string base = want;
+      // "_r" is the last suffix of the canonical order: strip it before
+      // extracting the "_k:" kernel suffix
+      std::string rsuffix;
+      {
+        std::string stripped = strip(base, "_r");
+        if (stripped.size() != base.size()) {
+          rsuffix = "_r";
+          base = stripped;
+        }
+      }
+      std::string ksuffix;
       size_t kp = base.find("_k:");
       if (kp != std::string::npos) {
         ksuffix = base.substr(kp);
@@ -1497,7 +1571,7 @@ Json simulate_only(const Json& req) {
       for (const std::string& ln : lattice) {
         if (pick != nullptr) break;
         for (const std::string& cand :
-             {ln + ksuffix, ln}) {
+             {ln + ksuffix + rsuffix, ln + ksuffix, ln + rsuffix, ln}) {
           if (cand == want) continue;
           pick = find(cand);
           if (pick != nullptr) break;
@@ -1529,8 +1603,13 @@ Json simulate_only(const Json& req) {
     bool circ = sched == "circular" ||
                 (sched != "gpipe" && kblocks > 1 && M >= mesh.pp);
     bool sq = pj.get("shard_queue").as_bool(cfg.pipeline_shard_queue);
+    // block-body rematerialization replays through the same pricing the
+    // search ranked it with (remat-search off forces it back off)
+    bool remat = cfg.enable_remat && cfg.training &&
+                 pj.get("remat").as_bool(false);
     r = simulate_pipeline(g, m, mesh, cs, pipe, cfg.training,
-                          cfg.opt_state_factor, &measured, M, circ, sq);
+                          cfg.opt_state_factor, &measured, M, circ, sq,
+                          remat);
   } else {
     TaskgraphSimulator sim(g, m, mesh, cfg.training, cfg.overlap,
                            cfg.opt_state_factor, &measured);

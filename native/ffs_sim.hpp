@@ -398,6 +398,13 @@ struct PipelineMeta {
 // `res.tasks` carries zero-duration census records (collective, bytes) so
 // strategy replays (ffs_simulate) can diff priced vs inferred/emitted
 // collectives on pipe meshes too.
+// `body_remat` prices block-body rematerialization (the pipeline face of
+// the "_r" dimension, ISSUE 20): the stage checkpoints each block
+// instance's boundary input and recomputes the block interior in
+// backward — backward ticks gain one forward tick of recompute, and the
+// body residual term shrinks from every interior activation to the
+// per-block boundaries (~1/block-depth). Swept as a candidate dimension
+// by eval_graph alongside M and the schedule.
 inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
                                    const MeshShape& mesh,
                                    const std::vector<Choice>& assign,
@@ -405,7 +412,8 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
                                    double opt_state_factor,
                                    const MeasuredCosts* measured, int M,
                                    bool circular = false,
-                                   bool shard_queue = true) {
+                                   bool shard_queue = true,
+                                   bool body_remat = false) {
   SimResult res;
   const int pp = mesh.pp;
   const int k = pp > 0 ? std::max(1, meta.num_blocks / pp) : 1;
@@ -527,6 +535,10 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   double op_floor = (double)body_ops / (pp * rounds) * m.min_op_time;
   double tick_fwd = std::max(fwd_body / ((double)pp * rounds * M), op_floor);
   double tick_bwd = std::max(bwd_body / ((double)pp * rounds * M), op_floor);
+  if (training && body_remat)
+    // block-body remat: every backward tick first re-runs the block's
+    // forward from its checkpointed boundary input
+    tick_bwd += tick_fwd;
   // activation hop: boundary tensor / (M * dp) per microbatch shard.
   // Each tick, every stage forwards simultaneously, so the tick's hop
   // cost is the slowest hop: if the pipeline's chip range extends past
@@ -625,20 +637,26 @@ inline SimResult simulate_pipeline(const Graph& g, const MachineModel& m,
   }
   // queue + output buffer: replicated over pipe in the fallback lowering,
   // sharded 1/pp otherwise (plus the in/out stream microbatches); the
-  // circular schedule keeps a stage-0 recirculation buffer — a full
-  // M-slot (data-sharded) boundary tensor in the replicated lowering,
-  // windowed to the M-pp+1 in-flight slots under the sharded queue
-  // (a value banked at tick v+pp-1 is consumed at tick v+M, so at most
-  // M-pp+1 slots are ever live — parallel/pipeline.py's ring buffer)
+  // circular schedule keeps a stage-0 recirculation buffer windowed to
+  // the M-pp+1 in-flight slots in BOTH lowerings (a value banked at tick
+  // v+pp-1 is consumed at tick v+M, so at most M-pp+1 slots are ever
+  // live — parallel/pipeline.py's ring buffer, data-sharded over dp)
   double queue_mem =
       2.0 * meta.block_out_bytes / mesh.dp / (qshard ? pp : 1);
   if (rounds > 1)
-    queue_mem += meta.block_out_bytes / mesh.dp *
-                 (qshard ? (double)(M - pp + 1) / M : 1.0);
+    queue_mem += meta.block_out_bytes / mesh.dp * (double)(M - pp + 1) / M;
   if (qshard)
     queue_mem += 3.0 * meta.block_out_bytes / ((double)M * mesh.dp);
+  double body_act_eff = body_act / pp;
+  if (training && body_remat && meta.block_out_bytes > 0)
+    // block-body remat residuals: k*M boundary slots of
+    // block_out/(M*dp) each per stage (= k*block_out/dp), plus the one
+    // block interior transiently rebuilt during the current backward
+    // tick — instead of every interior activation of the stage's blocks
+    body_act_eff = (double)k * meta.block_out_bytes / mesh.dp +
+                   body_act / ((double)meta.num_blocks * M);
   res.memory = body_param_mem / pp + ht_param_mem +
-               (training ? body_act / pp + ht_act : 0.0) + queue_mem;
+               (training ? body_act_eff + ht_act : 0.0) + queue_mem;
   return res;
 }
 

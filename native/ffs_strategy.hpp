@@ -142,6 +142,12 @@ struct Choice {
                                        // "flash" / "fused" /
                                        // "conv_bn_fused" for the "_k:"
                                        // choice twins — ISSUE 15)
+  bool remat = false;                  // rematerialization: checkpoint the
+                                       // op's boundary (inputs) and
+                                       // recompute its interior in backward
+                                       // — node_act_bytes drops to zero and
+                                       // node_cost charges one extra
+                                       // forward ("_r" twins — ISSUE 20)
 };
 
 // ---- kernel-implementation dimension ("_k:<impl>" twins) -------------------
@@ -208,6 +214,84 @@ inline std::string kernel_gate(const Node& n, const std::string& impl,
     return "";
   }
   return "unknown_impl";
+}
+
+// Layout-only ops XLA fuses into their producer/consumer on TPU: a slice,
+// concat or reshape of a matmul output compiles to index arithmetic inside
+// the neighboring fused kernel, not a standalone HBM round-trip. Charging
+// them real traffic would make kernel-fusion rewrites (one wide matmul +
+// split vs two narrow matmuls) look like losses when on hardware they win.
+inline bool is_view_op(const std::string& t) {
+  return t == "SPLIT" || t == "CONCAT" || t == "RESHAPE" || t == "FLAT" ||
+         t == "IDENTITY" || t == "NOOP" || t == "INPUT";
+}
+
+// ---- rematerialization dimension ("_r" twins) ------------------------------
+//
+// A "_r" twin checkpoints the op's boundary (input) activations and
+// recomputes its interior in backward: node_act_bytes drops to zero (the
+// inputs are already counted at their producers; the output is rebuilt
+// from them before the backward pass) and node_cost charges one extra
+// forward in backward — through the same measured > learned > analytic
+// chain, so a flash "_k:" parent's recompute prices the flash forward.
+// The frontier DP's existing per-candidate memory terms then weigh freed
+// HBM against recompute seconds per op: a memory-capped search picks "_r"
+// exactly where the freed bytes buy a better mesh/batch (ISSUE 20).
+
+// Structural legality of a remat twin of choice `c` on `n`: "" = legal,
+// else a named rejection reason recorded in the search trace. The
+// interior-vs-boundary test is impl-aware — einsum attention's interior
+// includes the materialized [B,H,S,S] score tensor (the same score-bytes
+// formula node_cost's flash delta subtracts); flash/ring never
+// materialize it, so their interior is the output alone.
+inline std::string remat_gate(const Node& n, const Choice& c,
+                              bool training = true) {
+  if (!training) return "not_training";
+  if (is_view_op(n.type)) return "view_op_no_interior";
+  // stateful interiors: recomputing the forward would re-advance state
+  // (BN running stats) or re-sample masks/assignments (dropout, MoE
+  // routing) — the recomputed interior would not match the one the
+  // forward pass produced, so numerics drift
+  if (n.type == "BATCH_NORM") return "stateful_interior";
+  if (n.type == "DROPOUT" || n.attrs.get("dropout").as_double(0.0) > 0.0)
+    return "dropout_interior";
+  if (n.type == "EXPERTS" || n.type == "AGGREGATE" || n.type == "GROUP_BY" ||
+      n.type == "TOPK" || n.type == "CACHE")
+    return "stateful_interior";
+  // the recompute re-runs the forward's collectives too; the pricing
+  // charges compute only, so choices whose forward moves bytes (psum /
+  // ring / gather / weight-gather) do not spawn twins — this also keeps
+  // the emitted collective census identical (recompute duplicates
+  // edges, not collectives)
+  if (c.psum_bytes > 0 || c.ring_bytes > 0 || c.gather_bytes > 0 ||
+      c.wgather_bytes > 0)
+    return "forward_collective_interior";
+  // interior (what the checkpoint frees) must exceed the boundary (what
+  // it keeps): output bytes + impl-aware extras vs the UNIQUE input
+  // tensors (self-attention's q=k=v count once)
+  double interior = 0;
+  for (size_t i = 0; i < n.output_shapes.size(); ++i)
+    interior += (double)n.output_bytes((int)i);
+  if (n.type == "MULTIHEAD_ATTENTION" && c.kernel != "flash" &&
+      c.name.find("_ring") == std::string::npos &&
+      !n.output_shapes.empty() && n.output_shapes[0].size() >= 2) {
+    int64_t heads = n.attrs.get("num_heads").as_int(1);
+    const Shape& os = n.output_shapes[0];
+    interior += (double)os[0] * (double)heads * (double)os[1] *
+                (double)os[1] * 4.0;
+  }
+  double boundary = 0;
+  std::vector<std::pair<int64_t, int>> seen;
+  for (size_t i = 0; i < n.input_shapes.size(); ++i) {
+    if (i < n.inputs.size() && n.inputs[i].src_guid >= 0) {
+      std::pair<int64_t, int> key{n.inputs[i].src_guid, n.inputs[i].src_idx};
+      if (std::find(seen.begin(), seen.end(), key) != seen.end()) continue;
+      seen.push_back(key);
+    }
+    boundary += (double)n.input_bytes((int)i);
+  }
+  if (interior <= boundary) return "interior_not_larger_than_boundary";
+  return "";
 }
 
 // ---- latency-hiding (comms-compute overlap) pricing -----------------------
@@ -366,7 +450,8 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
                                              bool enable_wus = false,
                                              bool enable_ovl = false,
                                              bool enable_kernels = false,
-                                             bool training = true) {
+                                             bool training = true,
+                                             bool enable_remat = false) {
   using detail::div_ok;
   using detail::dp_spec;
   const int dp = mesh.dp, mp = mesh.mp;
@@ -890,6 +975,25 @@ inline std::vector<Choice> enumerate_choices(const Node& n, const MeshShape& mes
       }
     }
   }
+
+  // ---- rematerialization ("_r") variants ----------------------------------
+  // Runs after the kernel block so "_r" is the final suffix of the
+  // canonical lattice base[_wus][_ovl][_k:impl][_r] and the recompute
+  // prices the actual lowering (a flash parent's "_r" twin recomputes
+  // the flash forward). Legality gates (remat_gate) fire here; their
+  // named reasons are re-derived into the search trace by per_op_trace.
+  if (enable_remat && training) {
+    const size_t base_count = out.size();
+    for (size_t bi = 0; bi < base_count; ++bi) {
+      // by VALUE: the push_backs below may reallocate `out`
+      const Choice b = out[bi];
+      if (!remat_gate(n, b, training).empty()) continue;
+      Choice c = b;
+      c.name += "_r";
+      c.remat = true;
+      out.push_back(std::move(c));
+    }
+  }
   return out;
 }
 
@@ -968,16 +1072,6 @@ inline double update_triad_time(const Node& n, const Choice& c,
   if (c.kernel == "fused")
     upd = std::max(0.0, upd - 2.0 * m.collective_launch_overhead);
   return upd;
-}
-
-// Layout-only ops XLA fuses into their producer/consumer on TPU: a slice,
-// concat or reshape of a matmul output compiles to index arithmetic inside
-// the neighboring fused kernel, not a standalone HBM round-trip. Charging
-// them real traffic would make kernel-fusion rewrites (one wide matmul +
-// split vs two narrow matmuls) look like losses when on hardware they win.
-inline bool is_view_op(const std::string& t) {
-  return t == "SPLIT" || t == "CONCAT" || t == "RESHAPE" || t == "FLAT" ||
-         t == "IDENTITY" || t == "NOOP" || t == "INPUT";
 }
 
 // Per-node forward/backward time. When a measured-cost table is supplied
@@ -1125,6 +1219,12 @@ inline NodeCost node_cost(const Node& n, const Choice& c, const MeshShape& mesh,
         nc.bwd = std::max(nc.bwd - bnd, floor_b);
     }
   }
+  if (training && c.remat)
+    // rematerialization: the backward pass first re-runs this op's
+    // forward from its checkpointed inputs. Applied after the per-impl
+    // delta so the recompute prices the chosen lowering; nc.src stays
+    // whatever priced fwd (cost_source provenance intact).
+    nc.bwd += nc.fwd;
   if (c.psum_bytes > 0 && c.psum_k > 1) {
     double t = m.allreduce_time(c.psum_bytes, c.psum_k, c.psum_axis);
     nc.comm = training ? 2.0 * t : t;  // bwd mirrors the collective
@@ -1202,6 +1302,9 @@ inline double node_param_memory(const Node& n, const Choice& c,
 inline double node_act_bytes(const Node& n, const Choice& c,
                              const MeshShape& mesh) {
   if (is_view_op(n.type)) return 0.0;  // fused away: materializes nothing
+  if (c.remat) return 0.0;  // "_r": the output is not a saved residual —
+                            // backward rebuilds it from the checkpointed
+                            // inputs (counted at their producers)
   double mem = 0;
   for (size_t i = 0; i < n.output_shapes.size(); ++i) {
     int k = i < c.out.size() ? shards_of(c.out[i], mesh) : 1;

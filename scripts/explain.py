@@ -155,6 +155,40 @@ def kernel_choice_rows(trace):
     return rows
 
 
+def remat_rows(trace):
+    """Per-op rematerialization table (the searched ``_r`` dimension,
+    ISSUE 20): ops where the search priced remat twins — the best
+    ``_r`` candidate's freed interior bytes vs the recompute seconds
+    its backward pays — plus the legality-gate rejections (stateful or
+    dropout interiors, an interior no larger than its boundary, ...).
+    Ops with neither a twin nor a rejection (e.g. view ops) are
+    omitted."""
+    rows = []
+    for op in trace.get("ops") or []:
+        cands = op.get("candidates") or []
+        r_cands = [c for c in cands if c.get("remat")]
+        rejections = op.get("remat_rejections") or []
+        if not r_cands and not rejections:
+            continue
+        chosen = next((c for c in cands if c.get("chosen")), None)
+        best_r = (min(r_cands, key=lambda c: c["terms"]["total_s"])
+                  if r_cands else None)
+        rows.append(dict(
+            name=op.get("name"), type=op.get("type"),
+            chosen=chosen["choice"] if chosen else None,
+            remat_won=bool(chosen and chosen.get("remat")),
+            best_r=best_r["choice"] if best_r else None,
+            freed_act_bytes=(best_r["remat"].get("freed_act_bytes")
+                             if best_r else None),
+            recompute_s=(best_r["remat"].get("recompute_s")
+                         if best_r else None),
+            total_s=best_r["terms"]["total_s"] if best_r else None,
+            rejections=[x.get("reason") for x in rejections],
+        ))
+    rows.sort(key=lambda r: -(r.get("freed_act_bytes") or 0))
+    return rows
+
+
 def learned_vs_analytic_disagreements(trace):
     """Ops where the learned and the analytic cost model rank a
     DIFFERENT winning choice (ISSUE 14: the disagreement is exactly
@@ -287,7 +321,8 @@ def write_sim_trace_file(trace_dir, model, sim_resp, name_of):
 
 def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
                 reasons, path_rows, path_total, merged_path,
-                disagreements=None, n_compared=0, kernel_rows=None):
+                disagreements=None, n_compared=0, kernel_rows=None,
+                remat_table=None):
     info = ff.search_info if isinstance(ff.search_info, dict) else {}
     stats = info.get("stats") or {}
     mesh = trace.get("winner_mesh") or {}
@@ -317,7 +352,8 @@ def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
         if m.get("status") == "winner" and trace.get("winner_pipeline"):
             wp = trace["winner_pipeline"]
             note = (f"M={wp.get('microbatches')} "
-                    f"{wp.get('schedule')}")
+                    f"{wp.get('schedule')}"
+                    + (" remat" if wp.get("remat") else ""))
         elif pl:
             note = f"{len(pl)} microbatch/schedule candidates"
         lines.append(
@@ -378,6 +414,34 @@ def to_markdown(model, ff, trace, sim_resp, rows, total_ops, feasible,
                 f"{r.get('cost_source') or '-'} | "
                 f"{alt['impl'] if alt else '-'} | "
                 f"{_fmt_s(alt['total_s'], 4) if alt else '-'} | {rej} |")
+    if remat_table:
+        lines += [
+            "",
+            "## Rematerialization (the searched `_r` dimension)",
+            "",
+            "Ops where the search priced a remat twin: freeing the "
+            "op's interior activations from the residual set (`freed`) "
+            "in exchange for recomputing its forward during backward "
+            "(`recompute`). `won` marks ops whose `_r` twin was chosen "
+            "— rare on a memory-feasible machine, since `_r` is "
+            "strictly slower; `rejected` names the legality gate that "
+            "kept a twin out (stateful/dropout interiors, or an "
+            "interior no larger than its boundary — e.g. flash "
+            "attention, whose fused kernel never materializes the "
+            "scores).",
+            "",
+            "| op | type | best `_r` twin | freed | recompute ms | "
+            "won | rejected |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in remat_table[:20]:
+            rej = "; ".join(r["rejections"]) or "-"
+            twin = f"`{r['best_r']}`" if r["best_r"] else "-"
+            lines.append(
+                f"| {r['name']} | {r['type']} | {twin} | "
+                f"{_fmt_bytes(r['freed_act_bytes'])} | "
+                f"{_fmt_s(r['recompute_s'], 4)} | "
+                f"{'yes' if r['remat_won'] else '-'} | {rej} |")
     if n_compared:
         lines += ["", "## Learned vs analytic cost model", ""]
         if disagreements:
@@ -561,6 +625,9 @@ def main():
     kernel_rows = kernel_choice_rows(trace)
     if kernel_rows:
         artifact["kernel_choices"] = kernel_rows
+    remat_table = remat_rows(trace)
+    if remat_table:
+        artifact["remat_choices"] = remat_table
     write_artifact(out_json, artifact, kind="search_trace")
 
     rows, total_ops = chosen_vs_runner_up(trace, top=args.top)
@@ -569,7 +636,8 @@ def main():
     md = to_markdown(args.model, ff, trace, sim_resp, rows, total_ops,
                      feasible, reasons, path_rows, path_total,
                      merged_path, disagreements=disagreements,
-                     n_compared=n_compared, kernel_rows=kernel_rows)
+                     n_compared=n_compared, kernel_rows=kernel_rows,
+                     remat_table=remat_table)
     out_md = os.path.join(args.out_dir, "EXPLAIN.md")
     with open(out_md, "w") as f:
         f.write(md)

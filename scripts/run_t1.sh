@@ -139,6 +139,43 @@ sys.exit(1 if missing else 0)
 EOF
 kernel_rc=$?
 if [ "$kernel_rc" -ne 0 ]; then echo "KERNEL: $(cat /tmp/_t1_kernel.out) — non-fatal"; else echo "KERNEL: $(cat /tmp/_t1_kernel.out)"; fi
+# Remat stage (ISSUE 20, non-fatal): the explain stage's
+# SEARCH_TRACE.json must carry the `_r` dimension's provenance — per-op
+# remat candidate rows (a `remat` block with freed_act_bytes and
+# recompute_s on every `_r` twin) and named legality-gate rejections
+# (remat_rejections), plus the rolled-up remat_choices table EXPLAIN.md
+# renders — so the searched memory-recompute tradeoff never silently
+# drops out of the trace.
+timeout -k 10 60 python - > /tmp/_t1_remat.out 2>&1 <<'EOF'
+import json, sys
+art = json.load(open("SEARCH_TRACE.json"))
+ops = (art.get("search_trace") or {}).get("ops") or []
+missing = []
+r_rows = [c for o in ops for c in (o.get("candidates") or [])
+          if c.get("remat")]
+if not r_rows:
+    missing.append("no candidate carries a remat block")
+bad = [c for c in r_rows
+       if not (c["remat"].get("freed_act_bytes", 0) > 0
+               and c["remat"].get("recompute_s", 0) > 0)]
+if bad:
+    missing.append(f"{len(bad)} remat rows without freed/recompute pricing")
+rej = [x for o in ops for x in (o.get("remat_rejections") or [])]
+if not rej:
+    missing.append("no op carries named remat_rejections")
+elif not all(x.get("reason") for x in rej):
+    missing.append("remat rejection without a reason")
+if not (art.get("remat_choices") or []):
+    missing.append("artifact carries no remat_choices rows")
+md = open("EXPLAIN.md").read()
+if "## Rematerialization" not in md:
+    missing.append("EXPLAIN.md lacks the rematerialization table")
+print("missing: " + ", ".join(missing) if missing
+      else f"ok ({len(r_rows)} _r rows, {len(rej)} rejections)")
+sys.exit(1 if missing else 0)
+EOF
+remat_rc=$?
+if [ "$remat_rc" -ne 0 ]; then echo "REMAT: $(cat /tmp/_t1_remat.out) — non-fatal"; else echo "REMAT: $(cat /tmp/_t1_remat.out)"; fi
 _t1_mark kernel
 # Elasticity stage (ISSUE 10, non-fatal): the tier-1-fast kill-and-resume
 # leg — 2 processes x 1 device, a host killed mid-epoch via FFS_FAULT,

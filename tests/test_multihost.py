@@ -39,10 +39,12 @@ class TestLocalBatchRows:
 
 
 class TestMultiProcess:
+    @pytest.mark.slow
     def test_two_process_gradient_sync_and_hlo_order(self, tmp_path):
-        """2 procs x 1 virtual device == one 2-device process. Tier-1
-        since the worker moved from the unsupported jax_num_cpu_devices
-        knob to --xla_force_host_platform_device_count (ROADMAP item).
+        """2 procs x 1 virtual device == one 2-device process. Slow tier
+        (t1 budget): a real 2-proc spawn stays tier-1 via
+        TestCheckpointFaultTolerance's fail-fast leg, and the elastic
+        2-proc dryrun also runs from scripts/run_t1.sh.
         ``trace_dir`` additionally makes every worker dump its optimized
         train-step HLO and the parent diff the per-host collective
         sequences through fflint's FFL501/502 static deadlock pass —

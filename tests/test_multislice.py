@@ -198,9 +198,15 @@ class TestFabricCensus:
             "  %c = f32[256]{0} all-reduce(%z)",
         ])
         fab = collective_census_by_fabric(hlo, chips_per_slice=4)
-        assert fab["ici"]["count"] == 1 and fab["ici"]["bytes"] == 4096.0
+        # decomposed attribution (r16): %b's groups hold ONE chip per
+        # slice (no intra-slice stage) — full 2048 B cross DCN; %c's
+        # implicit flat group decomposes hierarchically over the 4-chip
+        # slices, so 1024/4 B cross DCN and the intra-slice
+        # reduce-scatter/all-gather stages charge the rest to ICI
+        assert fab["ici"]["count"] == 1
+        assert fab["ici"]["bytes"] == 4096.0 + 256 * 4 * (1 - 1 / 4)
         assert fab["dcn"]["count"] == 2
-        assert fab["dcn"]["bytes"] == 512 * 4 + 256 * 4
+        assert fab["dcn"]["bytes"] == 512 * 4 + 256 * 4 / 4
 
 
 class TestRuntimeSliceAxis:

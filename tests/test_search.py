@@ -716,6 +716,31 @@ class TestArbitraryDcnTopology:
         slow = optimize([(0, 1, 0.3e9)])
         assert slow["predicted_time"] > fast["predicted_time"]
 
+    def test_partial_span_prices_its_own_links(self):
+        """ISSUE 20 satellite: machine_to_json ships the RAW per-pair
+        link matrix and the native pricer (MachineModel::dcn_ring)
+        bottlenecks over the slices a collective actually SPANS. A
+        dp=2 x mp=4 sync on a 4-slice line fabric crosses only the
+        0-1 pair: the far 1-2/2-3 links must not move the price (the
+        old global collapse charged their bottleneck), while slowing
+        the near 0-1 link must."""
+        machine16 = dict(MACHINE, num_devices=16, num_slices=4)
+
+        def sim(links):
+            nodes = mlp_graph(b=4096, d=4096, h=4096)
+            return native_simulate({
+                "machine": dict(machine16, dcn_links=links),
+                "config": _cfg(budget=0), "measured": {}, "nodes": nodes,
+                "mesh": {"data": 2, "model": 4, "seq": 1, "expert": 1},
+                "assignment": {"1": "dp_col", "2": "dp", "3": "dp_row"},
+            })["iteration_time"]
+
+        near_fast = sim([[0, 1, 50e9], [1, 2, 1e9], [2, 3, 50e9]])
+        near_only = sim([[0, 1, 50e9]])
+        near_slow = sim([[0, 1, 1e9], [1, 2, 50e9], [2, 3, 50e9]])
+        assert near_fast == near_only  # far links priced out of the span
+        assert near_slow > near_fast * 1.02
+
 
 class TestMemoryValidation:
     """SURVEY §7 hard part 4 / VERDICT r4 #6: predicted-vs-actual memory."""

@@ -476,7 +476,11 @@ class TestZooFoldedPredictParity:
         assert np.argmax(out, -1).tolist() == np.argmax(ref, -1).tolist()
         np.testing.assert_allclose(out, ref, atol=bf16_tol)
 
+    @pytest.mark.slow
     def test_resnet_bn(self):
+        # slow tier (t1 budget): Conv+BN fold parity stays tier-1 via
+        # test_layout.py::TestConvBNFold and cross-mesh load_for_serving
+        # via TestLoadForServing::test_cross_mesh_predict_equivalent
         from flexflow_tpu.models.resnet import ResNetConfig, create_resnet
         cfg = ResNetConfig(batch_size=4, image_size=32,
                            stages=(1, 1, 0, 0), num_classes=10,
